@@ -1,0 +1,26 @@
+"""mxnet_tpu: a TPU-native deep learning framework with MXNet's capabilities.
+
+A ground-up rebuild of Apache MXNet (~v1.1) for TPU: JAX/XLA is the execution
+engine (replacing the dependency engine + graph executor + kernel library,
+reference: src/engine, src/executor, src/operator), ``jax.sharding`` over
+device meshes replaces KVStore/ps-lite/NCCL (reference: src/kvstore), and the
+imperative/symbolic/Gluon API surfaces are re-implemented natively on top.
+
+Usage mirrors the reference:
+
+    import mxnet_tpu as mx
+    x = mx.nd.zeros((2, 3), ctx=mx.tpu(0))
+    with mx.autograd.record():
+        y = (x + 1).sum()
+    y.backward()
+"""
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from .random import seed

@@ -1,0 +1,356 @@
+"""Define-by-run autograd on top of ``jax.vjp``.
+
+TPU-native rebuild of the reference's imperative autograd
+(reference: src/imperative/imperative.cc:86-420, python/mxnet/autograd.py).
+
+Design: the reference records an NNVM graph node per imperative op and runs an
+NNVM ``Gradient`` pass at ``backward()`` time. Here every recorded op eagerly
+captures its VJP closure via ``jax.vjp`` (XLA keeps residuals on device), and
+``backward()`` is a reverse walk over the recorded tape. Leaves are NDArrays
+with ``attach_grad()`` / ``mark_variables`` (reference: autograd.py:197).
+
+Differences from the reference, by design:
+- No NNVM pass: JAX's tracing is the graph IR.
+- ``record()`` + hybridized blocks produce a *single* tape node whose VJP is
+  the XLA-compiled backward of the whole block (reference analog: CachedOp
+  backward, src/imperative/cached_op.cc:434).
+- Higher-order gradients go through ``create_graph=True`` which re-records the
+  backward ops (same contract as imperative.cc:331).
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "get_symbol",
+    "Function",
+]
+
+_state = threading.local()
+_node_counter = itertools.count()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording() -> bool:
+    """Whether autograd recording is on (reference: autograd.py:86)."""
+    return _st().recording
+
+
+def is_training() -> bool:
+    """Whether train-mode (dropout active etc.) is on (reference: autograd.py:93)."""
+    return _st().training
+
+
+def set_recording(is_rec: bool) -> bool:
+    st = _st()
+    prev, st.recording = st.recording, is_rec
+    return prev
+
+
+def set_training(train: bool) -> bool:
+    st = _st()
+    prev, st.training = st.training, train
+    return prev
+
+
+@contextlib.contextmanager
+def record(train_mode: bool = True):
+    """Record ops for autograd (reference: autograd.py:122)."""
+    prev_r = set_recording(True)
+    prev_t = set_training(train_mode)
+    try:
+        yield
+    finally:
+        set_recording(prev_r)
+        set_training(prev_t)
+
+
+@contextlib.contextmanager
+def pause(train_mode: bool = False):
+    """Stop recording inside a ``record()`` scope (reference: autograd.py:146)."""
+    prev_r = set_recording(False)
+    prev_t = set_training(train_mode)
+    try:
+        yield
+    finally:
+        set_recording(prev_r)
+        set_training(prev_t)
+
+
+@contextlib.contextmanager
+def train_mode():
+    prev_t = set_training(True)
+    try:
+        yield
+    finally:
+        set_training(prev_t)
+
+
+@contextlib.contextmanager
+def predict_mode():
+    prev_t = set_training(False)
+    try:
+        yield
+    finally:
+        set_training(prev_t)
+
+
+class TapeNode:
+    """One recorded op: VJP closure + links to parent arrays.
+
+    The analog of the reference's per-op NNVM node + ``AGInfo``
+    (include/mxnet/imperative.h:59-95).
+    """
+
+    __slots__ = ("seq", "vjp_fn", "parents", "n_out", "op_name", "outputs")
+
+    def __init__(self, vjp_fn, parents, n_out, op_name=""):
+        self.seq = next(_node_counter)
+        self.vjp_fn = vjp_fn
+        self.parents = parents  # list of NDArray (the *differentiable* inputs)
+        self.n_out = n_out
+        self.op_name = op_name
+        self.outputs: List[Any] = []  # weak-ish: set by record_op
+
+
+def record_op(op_name: str, fn: Callable, inputs: Sequence, raw_inputs: Sequence,
+              out_arrays: Sequence):
+    """Attach a tape node for an executed op.
+
+    ``fn(*arrays) -> tuple(arrays)`` is the pure function over the
+    differentiable inputs only; ``raw_inputs`` are the NDArray wrappers for
+    those inputs (leaves or intermediates); ``out_arrays`` the output NDArrays.
+    """
+    primals = [x.data if hasattr(x, "data") else x for x in inputs]
+    _, vjp_fn = jax.vjp(fn, *primals)
+    node = TapeNode(vjp_fn, list(raw_inputs), len(out_arrays), op_name)
+    for i, o in enumerate(out_arrays):
+        o._node = node
+        o._node_index = i
+    node.outputs = list(out_arrays)
+    return node
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Mark NDArrays as autograd leaves (reference: autograd.py:197)."""
+    if not isinstance(variables, (list, tuple)):
+        variables = [variables]
+        gradients = [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+        v._require_grad = req != "null"
+
+
+def _collect_graph(out_nodes):
+    """Reachable tape nodes from the given outputs, reverse-topological by seq."""
+    seen = {}
+    stack = list(out_nodes)
+    while stack:
+        node = stack.pop()
+        if node is None or node.seq in seen:
+            continue
+        seen[node.seq] = node
+        for p in node.parents:
+            pn = getattr(p, "_node", None)
+            if pn is not None and pn.seq not in seen:
+                stack.append(pn)
+    return [seen[s] for s in sorted(seen, reverse=True)]
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of ``heads`` w.r.t. marked variables.
+
+    Reference semantics: Imperative::Backward (src/imperative/imperative.cc:358)
+    — default head gradient is ones; gradients accumulate into ``.grad``
+    according to each leaf's ``grad_req`` ('write' overwrites, 'add'
+    accumulates; src/executor docs for kAddTo).
+    """
+    from .ndarray.ndarray import NDArray, _wrap  # local import to avoid cycle
+
+    _backward_seq[0] += 1
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+
+    # cotangent buffers keyed by (node.seq, out_index); leaf grads keyed by id
+    cotangents: Dict[tuple, Any] = {}
+    out_nodes = []
+    for h, hg in zip(heads, head_grads):
+        node = getattr(h, "_node", None)
+        g = hg.data if hasattr(hg, "data") else (
+            jnp.ones(h.shape, h.dtype) if hg is None else jnp.asarray(hg))
+        if node is None:
+            # head is itself a leaf
+            if getattr(h, "_require_grad", False):
+                _accumulate_leaf(h, g)
+            continue
+        key = (node.seq, h._node_index)
+        cotangents[key] = cotangents.get(key, 0) + g
+        out_nodes.append(node)
+
+    for node in _collect_graph(out_nodes):
+        cts = []
+        any_ct = False
+        for i, o in enumerate(node.outputs):
+            ct = cotangents.pop((node.seq, i), None)
+            if ct is None:
+                ct = jnp.zeros(o.shape, o.dtype)
+            else:
+                any_ct = True
+            cts.append(ct)
+        if not any_ct:
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"backward through op '{node.op_name}' a second time, but its "
+                "residuals were freed; call backward(retain_graph=True) the "
+                "first time")
+        in_grads = node.vjp_fn(tuple(cts))
+        for p, g in zip(node.parents, in_grads):
+            if g is None:
+                continue
+            pn = getattr(p, "_node", None)
+            if pn is not None:
+                key = (pn.seq, p._node_index)
+                prev = cotangents.get(key)
+                cotangents[key] = g if prev is None else prev + g
+            if getattr(p, "_require_grad", False):
+                _accumulate_leaf(p, g)
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals
+
+
+def _accumulate_leaf(leaf, g):
+    req = getattr(leaf, "_grad_req", "write")
+    if req == "null" or leaf._grad is None:
+        return
+    g = jnp.asarray(g, leaf._grad.dtype)
+    if req == "add":
+        leaf._grad._data = leaf._grad._data + g
+    else:  # write — but within one backward pass multiple paths accumulate
+        if getattr(leaf, "_grad_written_seq", None) == _backward_seq[0]:
+            leaf._grad._data = leaf._grad._data + g
+        else:
+            leaf._grad._data = g
+            leaf._grad_written_seq = _backward_seq[0]
+
+
+_backward_seq = [0]
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Return gradients of heads w.r.t. variables (reference: autograd.py:270).
+
+    Note: ``create_graph=True`` (higher-order) is routed through ``jax.grad``
+    composition by the caller; the imperative tape supports first-order here.
+    """
+    from .ndarray.ndarray import NDArray, _wrap
+
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    saved = [(getattr(v, "_grad", None), getattr(v, "_grad_req", "null"),
+              getattr(v, "_require_grad", False)) for v in variables]
+    for v in variables:
+        v._grad = _wrap(jnp.zeros(v.shape, v.dtype), v.context)
+        v._grad_req = "add"
+        v._require_grad = True
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph) or create_graph,
+                 train_mode=train_mode)
+        grads = [v._grad for v in variables]
+    finally:
+        for v, (g, req, rg) in zip(variables, saved):
+            v._grad, v._grad_req, v._require_grad = g, req, rg
+    return grads[0] if single else grads
+
+
+def get_symbol(x):  # pragma: no cover - compat
+    """Reference API (autograd.py:304) returns the recorded symbol; here the
+    recorded program is a tape of XLA computations, not a serializable symbol."""
+    raise NotImplementedError(
+        "get_symbol: recorded graphs are XLA computations in mxnet_tpu; "
+        "use hybridize()/Symbol for serializable graphs")
+
+
+class Function:
+    """Customized differentiable function (reference: autograd.py:364).
+
+    Subclass and override ``forward`` and ``backward``. Both run eagerly on
+    NDArrays; the backward is registered on the tape as an opaque VJP.
+    """
+
+    def __init__(self):
+        self._used = False
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return getattr(self, "_saved", ())
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray, _wrap
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            func = self
+
+            class _CustomNode(TapeNode):
+                pass
+
+            def _vjp(cts):
+                cts = cts if isinstance(cts, tuple) else (cts,)
+                with pause():
+                    gs = func.backward(*[_wrap(c) for c in cts])
+                if not isinstance(gs, (list, tuple)):
+                    gs = [gs]
+                return [g.data if hasattr(g, "data") else g for g in gs]
+
+            node = TapeNode(_vjp, [x for x in inputs if isinstance(x, NDArray)],
+                            len(outs), type(self).__name__)
+            for i, o in enumerate(outs):
+                o._node = node
+                o._node_index = i
+            node.outputs = outs
+        return outs[0] if single else outs

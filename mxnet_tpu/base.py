@@ -1,0 +1,102 @@
+"""Base utilities for mxnet_tpu.
+
+TPU-native rebuild of the role played by dmlc-core + python/mxnet/base.py in the
+reference (reference: python/mxnet/base.py, 3rdparty/dmlc-core). There is no C ABI
+here: JAX/XLA is the runtime, so "base" is registries, env-var config, and small
+shared helpers.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "MXNetError",
+    "Registry",
+    "get_env",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+]
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+integer_types = (int, np.integer)
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework.
+
+    Mirrors the role of ``mxnet.base.MXNetError`` (reference:
+    python/mxnet/base.py:69) without the TLS C-error plumbing — Python
+    exceptions propagate naturally since there is no C ABI boundary.
+    """
+
+
+def get_env(name: str, default, dtype: Optional[type] = None):
+    """Read a runtime configuration environment variable.
+
+    TPU-native analog of ``dmlc::GetEnv`` (reference: docs/faq/env_var.md).
+    Variables keep the ``MXNET_`` prefix so reference users' configs carry over.
+    """
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if dtype is None:
+        dtype = type(default) if default is not None else str
+    if dtype is bool:
+        return val.lower() not in ("0", "false", "off", "")
+    return dtype(val)
+
+
+class Registry:
+    """A simple name → object registry with alias support.
+
+    Plays the role of ``dmlc::Registry`` (used for ops, iterators, optimizers,
+    initializers, metrics throughout the reference, e.g.
+    src/engine/engine.cc:32, python/mxnet/optimizer.py:34).
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._entries: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def register(self, obj: Any = None, name: Optional[str] = None, aliases=()):
+        def _do(o):
+            key = name if name is not None else getattr(o, "__name__", None)
+            if key is None:
+                raise ValueError("cannot infer registry key")
+            with self._lock:
+                self._entries[key.lower()] = o
+                for a in aliases:
+                    self._entries[a.lower()] = o
+            return o
+
+        if obj is None:
+            return _do
+        return _do(obj)
+
+    def get(self, name: str):
+        try:
+            return self._entries[name.lower()]
+        except KeyError:
+            raise KeyError(
+                f"{self.name} registry has no entry '{name}'. "
+                f"Known: {sorted(set(self._entries))}"
+            ) from None
+
+    def __contains__(self, name: str) -> bool:
+        return name.lower() in self._entries
+
+    def keys(self):
+        return sorted(self._entries.keys())
+
+
+def check_call(ret):  # pragma: no cover - compat shim
+    """Compat shim: the reference checks C-API return codes (base.py:214);
+    there is no C ABI here, so this is a no-op kept for API parity."""
+    return ret

@@ -1,0 +1,43 @@
+"""Dtype name resolution shared across the package.
+
+The reference uses mshadow type codes + numpy names (mshadow type switch,
+python/mxnet/base.py _DTYPE_NP_TO_MX). Here dtypes are jnp dtypes; bfloat16 is
+first-class because it is the TPU MXU's native input type.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_ALIASES = {
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "bool": jnp.bool_,
+}
+
+# mshadow type codes (reference: include/mxnet/base.h / mshadow base.h)
+_CODE2DTYPE = {0: jnp.float32, 1: jnp.float64, 2: jnp.float16, 3: jnp.uint8,
+               4: jnp.int32, 5: jnp.int8, 6: jnp.int64}
+_DTYPE2CODE = {str(np.dtype(v)): k for k, v in _CODE2DTYPE.items()}
+
+
+def resolve_dtype(dtype):
+    """Resolve a dtype given as string, numpy dtype, jnp dtype or mshadow code."""
+    if dtype is None:
+        return jnp.float32
+    if isinstance(dtype, str):
+        return _ALIASES.get(dtype, np.dtype(dtype).type)
+    if isinstance(dtype, int):
+        return _CODE2DTYPE[dtype]
+    return dtype
+
+
+def dtype_code(dtype) -> int:
+    """mshadow-compatible code for .params serialization."""
+    return _DTYPE2CODE[str(np.dtype(dtype))]

@@ -1,0 +1,142 @@
+"""``mxnet_tpu.nd`` — the imperative op namespace.
+
+The reference generates these functions from the C op registry at import time
+(reference: python/mxnet/ndarray/register.py:29-156, base.py:470
+``_init_op_module``). Here the same happens from the Python op registry: every
+registered op becomes a module-level function taking NDArrays.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..context import Context, current_context
+from ..dtype import resolve_dtype
+from ..ops import get_op, list_ops
+from ..ops.registry import _OPS
+from .ndarray import NDArray, array, empty, waitall, _wrap, _invoke_op, _invoke_fn
+
+__all__ = ["NDArray", "array", "empty", "waitall", "zeros", "ones", "full",
+           "arange", "concat", "stack", "save", "load"]
+
+_CREATION_OPS = {"_zeros", "_ones", "_full", "_arange", "_eye", "_linspace",
+                 "_random_uniform", "_random_normal", "_random_gamma",
+                 "_random_exponential", "_random_poisson",
+                 "_random_negative_binomial",
+                 "_random_generalized_negative_binomial"}
+
+
+def _make_op_func(opdef):
+    def fn(*args, **kwargs):
+        ctx = kwargs.pop("ctx", None)
+        nd_args = []
+        for a in args:
+            if isinstance(a, NDArray):
+                nd_args.append(a)
+            elif isinstance(a, (list, tuple)) and a and isinstance(a[0], NDArray):
+                nd_args.extend(a)
+            elif isinstance(a, (np.ndarray, jnp.ndarray)):
+                nd_args.append(_wrap(jnp.asarray(a)))
+            else:
+                # scalar positional → attr by convention is not supported;
+                # treat as array scalar
+                nd_args.append(_wrap(jnp.asarray(a)))
+        if opdef.name in _CREATION_OPS or not nd_args:
+            # pure-attr op (creation/random): call directly
+            res = opdef.fn(**kwargs)
+            outs = res if isinstance(res, tuple) else (res,)
+            wrapped = tuple(_wrap(o if ctx is None else jax.device_put(o, ctx.jax_device), ctx)
+                            for o in outs)
+            return wrapped[0] if len(wrapped) == 1 else wrapped
+        return _invoke_op(opdef.name, nd_args, kwargs)
+
+    fn.__name__ = opdef.name
+    fn.__doc__ = opdef.fn.__doc__
+    return fn
+
+
+_mod = sys.modules[__name__]
+for _name in list(_OPS):
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make_op_func(_OPS[_name]))
+
+
+# -- creation functions with MXNet signatures --------------------------------
+def zeros(shape, ctx: Optional[Context] = None, dtype="float32"):
+    data = jnp.zeros(shape if isinstance(shape, tuple) else
+                     (tuple(shape) if isinstance(shape, list) else (shape,)),
+                     resolve_dtype(dtype))
+    if ctx is not None:
+        data = jax.device_put(data, ctx.jax_device)
+    return NDArray(data, ctx)
+
+
+def ones(shape, ctx: Optional[Context] = None, dtype="float32"):
+    data = jnp.ones(shape if isinstance(shape, tuple) else
+                    (tuple(shape) if isinstance(shape, list) else (shape,)),
+                    resolve_dtype(dtype))
+    if ctx is not None:
+        data = jax.device_put(data, ctx.jax_device)
+    return NDArray(data, ctx)
+
+
+def full(shape, val, ctx: Optional[Context] = None, dtype="float32"):
+    data = jnp.full(shape if isinstance(shape, tuple) else
+                    (tuple(shape) if isinstance(shape, list) else (shape,)),
+                    val, resolve_dtype(dtype))
+    if ctx is not None:
+        data = jax.device_put(data, ctx.jax_device)
+    return NDArray(data, ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    data = jnp.arange(start, stop, step, resolve_dtype(dtype))
+    if repeat != 1:
+        data = jnp.repeat(data, repeat)
+    if ctx is not None:
+        data = jax.device_put(data, ctx.jax_device)
+    return NDArray(data, ctx)
+
+
+def moveaxis(data, source, destination):
+    return _invoke_fn("moveaxis", lambda d: jnp.moveaxis(d, source, destination),
+                      [data])
+
+
+def onehot_encode(indices, out):
+    depth = out.shape[1]
+    res = _invoke_op("one_hot", [indices], {"depth": depth})
+    out._data = res._data
+    return out
+
+
+# -- serialization (reference: NDArray::Save src/ndarray/ndarray.cc:1571,
+#    python API mx.nd.save/load) — numpy .npz container with name keys.
+def save(fname, data):
+    if isinstance(data, NDArray):
+        arrs, names = [data], ["0"]
+    elif isinstance(data, (list, tuple)):
+        arrs, names = list(data), [str(i) for i in range(len(data))]
+    elif isinstance(data, dict):
+        names, arrs = list(data.keys()), list(data.values())
+    else:
+        raise TypeError("save requires NDArray, list or dict")
+    with open(fname, "wb") as f:
+        np.savez(f, __mxnet_tpu_names__=np.array(names, dtype=object),
+                 **{f"arr_{i}": a.asnumpy() for i, a in enumerate(arrs)})
+
+
+def load(fname):
+    with np.load(fname, allow_pickle=True) as zf:
+        names = [str(n) for n in zf["__mxnet_tpu_names__"]]
+        arrs = [array(zf[f"arr_{i}"]) for i in range(len(names))]
+    if all(n.isdigit() for n in names):
+        return arrs
+    return dict(zip(names, arrs))
+
+
+from . import random  # noqa: E402,F401

@@ -1,0 +1,584 @@
+"""NDArray: the imperative tensor frontend.
+
+TPU-native rebuild of the reference NDArray (reference:
+include/mxnet/ndarray.h:81-1320, python/mxnet/ndarray/ndarray.py). The
+reference pairs each array with an engine variable and schedules ops
+asynchronously (src/engine/threaded_engine.cc); here the *JAX runtime is the
+async engine* — every op returns immediately with a future-backed
+``jax.Array``, and ``wait_to_read()``/``asnumpy()`` are the sync points
+(ndarray.h:304-312 WaitToRead ≙ block_until_ready).
+
+Mutation (`+=`, slice assignment, optimizer updates) is realized by rebinding
+the wrapped functional array — the semantic equivalent of the reference's
+engine write-dependency versioning.
+"""
+from __future__ import annotations
+
+import functools
+import operator
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import autograd
+from ..base import MXNetError
+from ..context import Context, current_context, cpu
+from ..dtype import resolve_dtype
+from ..ops import get_op, has_op, list_ops
+from ..ops.registry import OpDef
+
+__all__ = ["NDArray", "array", "empty", "waitall", "_wrap"]
+
+_TRAINING_AWARE_OPS = {"Dropout", "BatchNorm"}
+
+
+class NDArray:
+    """An n-dimensional array on a device, with autograd support."""
+
+    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_require_grad",
+                 "_node", "_node_index", "_grad_written_seq", "__weakref__")
+
+    def __init__(self, data, ctx: Optional[Context] = None):
+        self._data = data
+        self._ctx = ctx
+        self._grad = None
+        self._grad_req = "null"
+        self._require_grad = False
+        self._node = None
+        self._node_index = 0
+        self._grad_written_seq = None
+
+    # -- basic properties ----------------------------------------------------
+    @property
+    def data(self):
+        """The underlying jax.Array."""
+        return self._data
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        if self._ctx is not None:
+            return self._ctx
+        dev = getattr(self._data, "device", None)
+        if dev is None or not hasattr(dev, "platform"):
+            return current_context()
+        if dev.platform == "cpu":
+            return Context("cpu", dev.id)
+        return Context("tpu", dev.id)
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    # -- sync / host transfer (reference: ndarray.h:304, .asnumpy) ----------
+    def wait_to_read(self):
+        if isinstance(self._data, jax.Array):
+            self._data.block_until_ready()
+        return self
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(()).item()
+
+    def item(self):
+        return self.asscalar()
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("ambiguous truth value of multi-element NDArray")
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __index__(self):
+        return int(self.asscalar())
+
+    def __repr__(self):
+        try:
+            body = str(self.asnumpy())
+        except Exception:
+            body = f"<traced {self.shape}>"
+        return f"\n{body}\n<NDArray {'x'.join(map(str, self.shape))} @{self.context}>"
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- autograd ------------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate gradient buffer and make this array a fresh autograd leaf,
+        severing any recorded history — matching the reference's
+        MXAutogradMarkVariables semantics (attach_grad detaches)."""
+        self._node = None
+        self._node_index = 0
+        self._grad = _wrap(jnp.zeros(self.shape, self._data.dtype), self._ctx)
+        self._grad_req = grad_req
+        self._require_grad = grad_req != "null"
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph, train_mode)
+
+    def detach(self):
+        out = NDArray(self._data, self._ctx)
+        return out
+
+    # -- conversion / movement ----------------------------------------------
+    def astype(self, dtype, copy=True):
+        return _invoke_fn("astype", lambda d: d.astype(resolve_dtype(dtype)), [self])
+
+    def copy(self):
+        return NDArray(self._data, self._ctx)
+
+    def copyto(self, other):
+        """Reference: CopyFromTo (src/ndarray/ndarray.cc:1186) — cross-device
+        copy; here jax.device_put."""
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(self._data, other.context.jax_device)
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device), other)
+        raise TypeError(f"copyto does not support {type(other)}")
+
+    def as_in_context(self, ctx: Context):
+        if ctx == self.context:
+            return self
+        return NDArray(jax.device_put(self._data, ctx.jax_device), ctx)
+
+    as_in_ctx = as_in_context
+
+    def tostype(self, stype):
+        if stype != "default":
+            try:
+                from .sparse import dense_to_sparse
+            except ImportError:
+                raise NotImplementedError(
+                    f"sparse storage type '{stype}' not yet available") from None
+            return dense_to_sparse(self, stype)
+        return self
+
+    # -- shape ops as methods ------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        return _invoke_op("Reshape", [self], {"shape": shape,
+                                              "reverse": kwargs.get("reverse", False)})
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _invoke_op("transpose", [self], {"axes": axes or None})
+
+    def expand_dims(self, axis):
+        return _invoke_op("expand_dims", [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return _invoke_op("squeeze", [self], {"axis": axis})
+
+    def flatten(self):
+        return _invoke_op("Flatten", [self], {})
+
+    def swapaxes(self, dim1, dim2):
+        return _invoke_op("SwapAxis", [self], {"dim1": dim1, "dim2": dim2})
+
+    def flip(self, axis):
+        return _invoke_op("reverse", [self], {"axis": axis})
+
+    def tile(self, reps):
+        return _invoke_op("tile", [self], {"reps": reps})
+
+    def repeat(self, repeats, axis=None):
+        return _invoke_op("repeat", [self], {"repeats": repeats, "axis": axis})
+
+    def pad(self, mode="constant", pad_width=(), constant_value=0.0):
+        return _invoke_op("Pad", [self], {"mode": mode, "pad_width": pad_width,
+                                          "constant_value": constant_value})
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return _invoke_op("SliceChannel", [self],
+                          {"num_outputs": num_outputs, "axis": axis,
+                           "squeeze_axis": squeeze_axis})
+
+    def slice(self, begin, end, step=None):
+        return _invoke_op("slice", [self], {"begin": begin, "end": end,
+                                            "step": step or ()})
+
+    def slice_axis(self, axis, begin, end):
+        return _invoke_op("slice_axis", [self], {"axis": axis, "begin": begin,
+                                                 "end": end})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return _invoke_op("take", [self, indices], {"axis": axis, "mode": mode})
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+        return _invoke_op("one_hot", [self], {"depth": depth, "on_value": on_value,
+                                              "off_value": off_value, "dtype": dtype})
+
+    def clip(self, a_min=None, a_max=None):
+        return _invoke_op("clip", [self], {"a_min": a_min, "a_max": a_max})
+
+    def abs(self):
+        return _invoke_op("abs", [self], {})
+
+    def sign(self):
+        return _invoke_op("sign", [self], {})
+
+    def sqrt(self):
+        return _invoke_op("sqrt", [self], {})
+
+    def square(self):
+        return _invoke_op("square", [self], {})
+
+    def exp(self):
+        return _invoke_op("exp", [self], {})
+
+    def log(self):
+        return _invoke_op("log", [self], {})
+
+    def relu(self):
+        return _invoke_op("relu", [self], {})
+
+    def sigmoid(self):
+        return _invoke_op("sigmoid", [self], {})
+
+    def tanh(self):
+        return _invoke_op("tanh", [self], {})
+
+    def softmax(self, axis=-1):
+        return _invoke_op("softmax", [self], {"axis": axis})
+
+    def log_softmax(self, axis=-1):
+        return _invoke_op("log_softmax", [self], {"axis": axis})
+
+    def sum(self, axis=None, keepdims=False, exclude=False):
+        return _invoke_op("sum", [self], {"axis": axis, "keepdims": keepdims,
+                                          "exclude": exclude})
+
+    def mean(self, axis=None, keepdims=False, exclude=False):
+        return _invoke_op("mean", [self], {"axis": axis, "keepdims": keepdims,
+                                           "exclude": exclude})
+
+    def prod(self, axis=None, keepdims=False):
+        return _invoke_op("prod", [self], {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return _invoke_op("max", [self], {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False):
+        return _invoke_op("min", [self], {"axis": axis, "keepdims": keepdims})
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return _invoke_op("norm", [self], {"ord": ord, "axis": axis,
+                                           "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False):
+        return _invoke_op("argmax", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        return _invoke_op("argmin", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return _invoke_op("argsort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def sort(self, axis=-1, is_ascend=True):
+        return _invoke_op("sort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return _invoke_op("topk", [self], {"axis": axis, "k": k,
+                                           "ret_typ": ret_typ,
+                                           "is_ascend": is_ascend})
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return _invoke_op("dot", [self, other],
+                          {"transpose_a": transpose_a, "transpose_b": transpose_b})
+
+    def zeros_like(self):
+        return _invoke_op("zeros_like", [self], {})
+
+    def ones_like(self):
+        return _invoke_op("ones_like", [self], {})
+
+    # -- indexing ------------------------------------------------------------
+    def __getitem__(self, key):
+        key = _convert_key(key)
+        return _invoke_fn("getitem", lambda d: d[key], [self])
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value._data
+        full = key is None or (isinstance(key, slice) and key == slice(None))
+        if full:
+            self._data = jnp.broadcast_to(
+                jnp.asarray(value, self._data.dtype), self.shape)
+            return
+        key = _convert_key(key)
+        self._data = self._data.at[key].set(jnp.asarray(value, self._data.dtype))
+
+    # -- arithmetic ----------------------------------------------------------
+    def __add__(self, other):
+        if isinstance(other, NDArray):
+            return _invoke_op("broadcast_add", [self, other], {})
+        return _invoke_op("_plus_scalar", [self], {"scalar": other})
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        if isinstance(other, NDArray):
+            return _invoke_op("broadcast_sub", [self, other], {})
+        return _invoke_op("_minus_scalar", [self], {"scalar": other})
+
+    def __rsub__(self, other):
+        return _invoke_op("_rminus_scalar", [self], {"scalar": other})
+
+    def __mul__(self, other):
+        if isinstance(other, NDArray):
+            return _invoke_op("broadcast_mul", [self, other], {})
+        return _invoke_op("_mul_scalar", [self], {"scalar": other})
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if isinstance(other, NDArray):
+            return _invoke_op("broadcast_div", [self, other], {})
+        return _invoke_op("_div_scalar", [self], {"scalar": other})
+
+    def __rtruediv__(self, other):
+        return _invoke_op("_rdiv_scalar", [self], {"scalar": other})
+
+    def __mod__(self, other):
+        if isinstance(other, NDArray):
+            return _invoke_op("broadcast_mod", [self, other], {})
+        return _invoke_op("_mod_scalar", [self], {"scalar": other})
+
+    def __rmod__(self, other):
+        return _invoke_op("_rmod_scalar", [self], {"scalar": other})
+
+    def __pow__(self, other):
+        if isinstance(other, NDArray):
+            return _invoke_op("broadcast_power", [self, other], {})
+        return _invoke_op("_power_scalar", [self], {"scalar": other})
+
+    def __rpow__(self, other):
+        return _invoke_op("_rpower_scalar", [self], {"scalar": other})
+
+    def __matmul__(self, other):
+        return _invoke_op("dot", [self, other], {})
+
+    def __neg__(self):
+        return _invoke_op("negative", [self], {})
+
+    def __abs__(self):
+        return _invoke_op("abs", [self], {})
+
+    def __iadd__(self, other):
+        out = self.__add__(other)
+        self._data, self._node, self._node_index = out._data, out._node, out._node_index
+        return self
+
+    def __isub__(self, other):
+        out = self.__sub__(other)
+        self._data, self._node, self._node_index = out._data, out._node, out._node_index
+        return self
+
+    def __imul__(self, other):
+        out = self.__mul__(other)
+        self._data, self._node, self._node_index = out._data, out._node, out._node_index
+        return self
+
+    def __itruediv__(self, other):
+        out = self.__truediv__(other)
+        self._data, self._node, self._node_index = out._data, out._node, out._node_index
+        return self
+
+    def __eq__(self, other):
+        if isinstance(other, NDArray):
+            return _invoke_op("broadcast_equal", [self, other], {})
+        if other is None:
+            return False
+        return _invoke_op("_equal_scalar", [self], {"scalar": other})
+
+    def __ne__(self, other):
+        if isinstance(other, NDArray):
+            return _invoke_op("broadcast_not_equal", [self, other], {})
+        if other is None:
+            return True
+        return _invoke_op("_not_equal_scalar", [self], {"scalar": other})
+
+    def __gt__(self, other):
+        if isinstance(other, NDArray):
+            return _invoke_op("broadcast_greater", [self, other], {})
+        return _invoke_op("_greater_scalar", [self], {"scalar": other})
+
+    def __ge__(self, other):
+        if isinstance(other, NDArray):
+            return _invoke_op("broadcast_greater_equal", [self, other], {})
+        return _invoke_op("_greater_equal_scalar", [self], {"scalar": other})
+
+    def __lt__(self, other):
+        if isinstance(other, NDArray):
+            return _invoke_op("broadcast_lesser", [self, other], {})
+        return _invoke_op("_lesser_scalar", [self], {"scalar": other})
+
+    def __le__(self, other):
+        if isinstance(other, NDArray):
+            return _invoke_op("broadcast_lesser_equal", [self, other], {})
+        return _invoke_op("_lesser_equal_scalar", [self], {"scalar": other})
+
+    def __hash__(self):
+        return id(self)
+
+
+def _convert_key(key):
+    def conv(k):
+        if isinstance(k, NDArray):
+            return k._data.astype(jnp.int32)
+        return k
+    if isinstance(key, tuple):
+        return tuple(conv(k) for k in key)
+    return conv(key)
+
+
+def _wrap(data, ctx: Optional[Context] = None) -> NDArray:
+    return NDArray(data, ctx)
+
+
+def _invoke_fn(name, fn, nd_inputs, n_out=1):
+    """Run a pure function over NDArray inputs with autograd tape recording.
+
+    The analog of Imperative::Invoke (reference:
+    src/imperative/imperative.cc:86): execute, then RecordOp if recording.
+    """
+    arrays = [x._data for x in nd_inputs]
+    recording = autograd.is_recording()
+    diff_idx = [i for i, a in enumerate(arrays)
+                if jnp.issubdtype(jnp.result_type(a), jnp.inexact)]
+    if recording and diff_idx:
+        def closed(*diff_arrays):
+            full = list(arrays)
+            for i, arr in zip(diff_idx, diff_arrays):
+                full[i] = arr
+            res = fn(*full)
+            return res if isinstance(res, tuple) else (res,)
+
+        primals = [arrays[i] for i in diff_idx]
+        outs, vjp_fn = jax.vjp(closed, *primals)
+        out_nds = [_wrap(o) for o in outs]
+        node = autograd.TapeNode(vjp_fn, [nd_inputs[i] for i in diff_idx],
+                                 len(out_nds), name)
+        for i, o in enumerate(out_nds):
+            o._node = node
+            o._node_index = i
+        node.outputs = out_nds
+    else:
+        res = fn(*arrays)
+        outs = res if isinstance(res, tuple) else (res,)
+        out_nds = [_wrap(o) for o in outs]
+    return out_nds[0] if len(out_nds) == 1 else tuple(out_nds)
+
+
+def _invoke_op(name, nd_inputs, attrs):
+    opdef = get_op(name)
+    attrs = {k: v for k, v in attrs.items() if v is not None or k in ("axis", "axes", "a_min", "a_max")}
+    out = attrs.pop("out", None)
+    if opdef.name in _TRAINING_AWARE_OPS:
+        attrs.setdefault("training", autograd.is_training())
+    if opdef.no_grad:
+        arrays = [x._data if isinstance(x, NDArray) else x for x in nd_inputs]
+        res = opdef.fn(*arrays, **attrs)
+        outs = res if isinstance(res, tuple) else (res,)
+        result = tuple(_wrap(o) for o in outs)
+        result = result[0] if len(result) == 1 else result
+    else:
+        result = _invoke_fn(opdef.name, functools.partial(_call_with_attrs, opdef, attrs),
+                            [x if isinstance(x, NDArray) else _wrap(jnp.asarray(x))
+                             for x in nd_inputs])
+    if out is not None:
+        first = result[0] if isinstance(result, tuple) else result
+        out._data = first._data
+        out._node = first._node
+        out._node_index = first._node_index
+        return out
+    return result
+
+
+def _call_with_attrs(opdef, attrs, *arrays):
+    return opdef.fn(*arrays, **attrs)
+
+
+# ---------------------------------------------------------------------------
+# module-level creation & utility functions
+# ---------------------------------------------------------------------------
+def array(source_array, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    """Create an NDArray from any array-like (reference: ndarray.py array)."""
+    if isinstance(source_array, NDArray):
+        data = source_array._data
+    else:
+        np_arr = np.asarray(source_array)
+        if dtype is None and np_arr.dtype == np.float64:
+            dtype = np.float32  # MXNet default dtype semantics
+        data = np_arr
+    if dtype is not None:
+        data = jnp.asarray(data, resolve_dtype(dtype))
+    else:
+        data = jnp.asarray(data)
+    if ctx is not None:
+        data = jax.device_put(data, ctx.jax_device)
+    return NDArray(data, ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return array(np.zeros(shape, np.dtype(resolve_dtype(dtype))), ctx)
+
+
+def waitall():
+    """Block until all queued work completes (reference: engine WaitForAll)."""
+    try:
+        (jax.device_put(0.0) + 0).block_until_ready()
+    except Exception:
+        pass

@@ -1,0 +1,68 @@
+"""``mx.nd.random`` namespace (reference: python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+from ..ops import get_op
+from .ndarray import NDArray, _wrap, _invoke_op
+
+__all__ = ["uniform", "normal", "gamma", "exponential", "poisson",
+           "negative_binomial", "generalized_negative_binomial", "multinomial",
+           "shuffle", "randn"]
+
+
+def _creation(name, **kwargs):
+    import jax
+    ctx = kwargs.pop("ctx", None)
+    kwargs.pop("out", None)
+    res = get_op(name).fn(**kwargs)
+    if ctx is not None:
+        res = jax.device_put(res, ctx.jax_device)
+    return _wrap(res, ctx)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    return _creation("_random_uniform", low=low, high=high, shape=shape,
+                     dtype=dtype, ctx=ctx)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    return _creation("_random_normal", loc=loc, scale=scale, shape=shape,
+                     dtype=dtype, ctx=ctx)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None, **kw):
+    return normal(loc, scale, shape, dtype, ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    return _creation("_random_gamma", alpha=alpha, beta=beta, shape=shape,
+                     dtype=dtype, ctx=ctx)
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    return _creation("_random_exponential", lam=1.0 / scale, shape=shape,
+                     dtype=dtype, ctx=ctx)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    return _creation("_random_poisson", lam=lam, shape=shape, dtype=dtype, ctx=ctx)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None,
+                      out=None, **kw):
+    return _creation("_random_negative_binomial", k=k, p=p, shape=shape,
+                     dtype=dtype, ctx=ctx)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype="float32",
+                                  ctx=None, out=None, **kw):
+    return _creation("_random_generalized_negative_binomial", mu=mu, alpha=alpha,
+                     shape=shape, dtype=dtype, ctx=ctx)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):
+    return _invoke_op("_sample_multinomial", [data],
+                      {"shape": shape, "get_prob": get_prob, "dtype": dtype})
+
+
+def shuffle(data, **kw):
+    return _invoke_op("_shuffle", [data], {})

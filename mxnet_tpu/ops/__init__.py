@@ -1,0 +1,19 @@
+"""Functional operator library (single source of truth for nd/sym/jit).
+
+Importing this package registers the full op surface. Pallas kernels for the
+ops XLA can't fuse well live in ``mxnet_tpu.ops.pallas_kernels``.
+"""
+from .registry import (OpDef, register_op, get_op, has_op, list_ops, alias,
+                       parse_attr)
+
+from . import elemwise  # noqa: F401
+from . import reduce  # noqa: F401
+from . import shape_ops  # noqa: F401
+from . import creation  # noqa: F401
+from . import nn  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import linalg  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+
+__all__ = ["OpDef", "register_op", "get_op", "has_op", "list_ops", "alias",
+           "parse_attr"]

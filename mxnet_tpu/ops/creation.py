@@ -1,0 +1,104 @@
+"""Array creation + sorting/searching ops.
+
+Reference surface: src/operator/tensor/init_op.cc (zeros/ones/full/arange/eye),
+ordering_op.cc (sort/argsort/topk).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+from ..dtype import resolve_dtype
+
+
+@register_op("_zeros", aliases=["zeros"], no_grad=True)
+def zeros(shape=(), ctx=None, dtype="float32", **kw):
+    return jnp.zeros(tuple(shape) if not isinstance(shape, int) else (shape,),
+                     resolve_dtype(dtype))
+
+
+@register_op("_ones", aliases=["ones"], no_grad=True)
+def ones(shape=(), ctx=None, dtype="float32", **kw):
+    return jnp.ones(tuple(shape) if not isinstance(shape, int) else (shape,),
+                    resolve_dtype(dtype))
+
+
+@register_op("_full", aliases=["full"], no_grad=True)
+def full(shape=(), value=0.0, ctx=None, dtype="float32", **kw):
+    return jnp.full(tuple(shape) if not isinstance(shape, int) else (shape,),
+                    value, resolve_dtype(dtype))
+
+
+@register_op("_arange", aliases=["arange"], no_grad=True)
+def arange(start=0, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32",
+           infer_range=False, **kw):
+    out = jnp.arange(start, stop, step, resolve_dtype(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register_op("_eye", aliases=["eye"], no_grad=True)
+def eye(N=0, M=0, k=0, ctx=None, dtype="float32", **kw):
+    return jnp.eye(int(N), int(M) if M else None, k=int(k),
+                   dtype=resolve_dtype(dtype))
+
+
+@register_op("_linspace", aliases=["linspace"], no_grad=True)
+def linspace(start=0.0, stop=1.0, num=50, endpoint=True, ctx=None,
+             dtype="float32", **kw):
+    return jnp.linspace(start, stop, int(num), endpoint=endpoint,
+                        dtype=resolve_dtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# ordering (reference: src/operator/tensor/ordering_op.cc)
+# ---------------------------------------------------------------------------
+@register_op("sort")
+def sort(data, axis=-1, is_ascend=True, **kw):
+    if axis is None:
+        data = data.reshape(-1)
+        axis = -1
+    out = jnp.sort(data, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register_op("argsort", no_grad=True)
+def argsort(data, axis=-1, is_ascend=True, dtype="float32", **kw):
+    if axis is None:
+        data = data.reshape(-1)
+        axis = -1
+    idx = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(resolve_dtype(dtype))
+
+
+@register_op("topk", no_grad=True)
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32", **kw):
+    """Reference: ordering_op.cc TopK. ret_typ in {value, indices, mask, both}."""
+    axis = axis % data.ndim if axis is not None else None
+    if axis is None:
+        data = data.reshape(-1)
+        axis = 0
+    x = -data if is_ascend else data  # lax.top_k selects the largest
+    moved = jnp.moveaxis(x, axis, -1)
+    _, idx = __top_k(moved, k)
+    true_vals = jnp.take_along_axis(
+        jnp.moveaxis(data, axis, -1), idx, axis=-1)
+    true_vals = jnp.moveaxis(true_vals, -1, axis)
+    indices = jnp.moveaxis(idx, -1, axis).astype(resolve_dtype(dtype))
+    if ret_typ == "value":
+        return true_vals
+    if ret_typ == "indices":
+        return indices
+    if ret_typ == "mask":
+        oh = jnp.sum(jnp.eye(data.shape[axis], dtype=resolve_dtype(dtype))[idx],
+                     axis=-2)
+        return jnp.moveaxis(oh, -1, axis)
+    return true_vals, indices
+
+
+def __top_k(x, k):
+    import jax.lax as lax
+    return lax.top_k(x, k)

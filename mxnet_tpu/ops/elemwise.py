@@ -1,0 +1,181 @@
+"""Elementwise unary/binary/scalar/comparison operators.
+
+Reference surface: src/operator/tensor/elemwise_unary_op_basic.cc,
+elemwise_binary_op_basic.cc, elemwise_binary_broadcast_op_*.cc,
+elemwise_binary_scalar_op_*.cc, src/operator/mshadow_op.h. All lower to jnp —
+XLA fuses chains of these into single TPU kernels, replacing the reference's
+hand-written mshadow expression templates.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, alias
+
+
+# ---------------------------------------------------------------------------
+# unary
+# ---------------------------------------------------------------------------
+_UNARY = {
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "rint": jnp.rint,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,  # round toward zero (jnp.fix deprecated alias)
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "reciprocal": jnp.reciprocal,
+    "negative": jnp.negative,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+}
+
+for _name, _fn in _UNARY.items():
+    register_op(_name)(
+        (lambda f: lambda data, **kw: f(data))(_fn))
+
+alias("relu", "Relu")
+register_op("identity", aliases=["_copy"])(lambda data, **kw: data)
+register_op("BlockGrad", aliases=["stop_gradient"])(
+    lambda data, **kw: jax.lax.stop_gradient(data))
+register_op("make_loss", aliases=["MakeLoss"])(lambda data, **kw: data)
+
+
+@register_op("add_n", aliases=["ElementWiseSum", "_sum"])
+def add_n(*args, num_args=None, **kw):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register_op("smooth_l1")
+def smooth_l1(data, scalar=1.0, **kw):
+    """Reference: src/operator/mshadow_op.h smooth_l1 (used by SSD/RCNN)."""
+    s2 = scalar * scalar
+    absd = jnp.abs(data)
+    return jnp.where(absd < 1.0 / s2, 0.5 * s2 * data * data, absd - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# binary (broadcasting; MXNet's elemwise_* and broadcast_* collapse to one
+# implementation since jnp broadcasts by default)
+# ---------------------------------------------------------------------------
+def _fmod(a, b):
+    return jnp.fmod(a, b)
+
+
+_BINARY = {
+    "broadcast_add": jnp.add,
+    "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_mod": _fmod,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+    "arctan2": jnp.arctan2,
+}
+
+_BIN_ALIASES = {
+    "broadcast_add": ["elemwise_add", "_add", "_plus", "_Plus"],
+    "broadcast_sub": ["elemwise_sub", "_sub", "_minus", "_Minus"],
+    "broadcast_mul": ["elemwise_mul", "_mul", "_Mul"],
+    "broadcast_div": ["elemwise_div", "_div", "_Div"],
+    "broadcast_mod": ["_mod"],
+    "broadcast_power": ["_power", "_Power", "pow"],
+    "broadcast_maximum": ["_maximum", "maximum"],
+    "broadcast_minimum": ["_minimum", "minimum"],
+}
+
+for _name, _fn in _BINARY.items():
+    register_op(_name, aliases=_BIN_ALIASES.get(_name, ()))(
+        (lambda f: lambda lhs, rhs, **kw: f(lhs, rhs))(_fn))
+
+
+# comparisons return float (0/1) like the reference (mshadow_op.h eq/ne/...)
+def _cmp(f):
+    def impl(lhs, rhs, **kw):
+        out = f(lhs, rhs)
+        return out.astype(jnp.result_type(lhs))
+    return impl
+
+
+for _name, _fn, _al in [
+    ("broadcast_equal", jnp.equal, ["_equal"]),
+    ("broadcast_not_equal", jnp.not_equal, ["_not_equal"]),
+    ("broadcast_greater", jnp.greater, ["_greater"]),
+    ("broadcast_greater_equal", jnp.greater_equal, ["_greater_equal"]),
+    ("broadcast_lesser", jnp.less, ["_lesser"]),
+    ("broadcast_lesser_equal", jnp.less_equal, ["_lesser_equal"]),
+    ("broadcast_logical_and", jnp.logical_and, ["_logical_and"]),
+    ("broadcast_logical_or", jnp.logical_or, ["_logical_or"]),
+    ("broadcast_logical_xor", jnp.logical_xor, ["_logical_xor"]),
+]:
+    register_op(_name, aliases=_al, no_grad=True)(_cmp(_fn))
+
+
+# ---------------------------------------------------------------------------
+# scalar ops (reference: elemwise_binary_scalar_op_*.cc)
+# ---------------------------------------------------------------------------
+_SCALAR = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: jnp.fmod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.fmod(s, x),
+    "_power_scalar": lambda x, s: x ** s,
+    "_rpower_scalar": lambda x, s: jnp.asarray(s, x.dtype) ** x,
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)),
+    "_equal_scalar": lambda x, s: (x == s).astype(x.dtype),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(x.dtype),
+    "_greater_scalar": lambda x, s: (x > s).astype(x.dtype),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(x.dtype),
+    "_lesser_scalar": lambda x, s: (x < s).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(x.dtype),
+    "_logical_and_scalar": lambda x, s: jnp.logical_and(x, s).astype(x.dtype),
+    "_logical_or_scalar": lambda x, s: jnp.logical_or(x, s).astype(x.dtype),
+    "_logical_xor_scalar": lambda x, s: jnp.logical_xor(x, s).astype(x.dtype),
+}
+
+for _name, _fn in _SCALAR.items():
+    register_op(_name, aliases=[_name.lstrip("_")])(
+        (lambda f: lambda data, scalar=0.0, **kw: f(data, scalar))(_fn))

@@ -1,0 +1,92 @@
+"""Linear-algebra operators.
+
+Reference surface: src/operator/tensor/la_op.cc (linalg_gemm/gemm2/potrf/potri/
+trmm/trsm/sumlogdiag/syrk/gelqf/syevd) — cuBLAS/LAPACK there, XLA here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+
+from .registry import register_op
+
+
+def _t(x, transpose):
+    return jnp.swapaxes(x, -1, -2) if transpose else x
+
+
+@register_op("linalg_gemm", aliases=["_linalg_gemm"])
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2, **kw):
+    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b)) + beta * C
+
+
+@register_op("linalg_gemm2", aliases=["_linalg_gemm2"])
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2, **kw):
+    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b))
+
+
+@register_op("linalg_potrf", aliases=["_linalg_potrf"])
+def linalg_potrf(A, **kw):
+    return jnp.linalg.cholesky(A)
+
+
+@register_op("linalg_potri", aliases=["_linalg_potri"])
+def linalg_potri(A, **kw):
+    """Inverse from Cholesky factor L: (L L^T)^-1."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    linv = jsl.solve_triangular(A, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register_op("linalg_trsm", aliases=["_linalg_trsm"])
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0, **kw):
+    if rightside:
+        # X A = alpha B  ⇔  A^T X^T = alpha B^T; passing A^T flips triangularity
+        xt = jsl.solve_triangular(_t(A, not transpose), _t(alpha * B, True),
+                                  lower=lower if transpose else not lower)
+        return _t(xt, True)
+    return jsl.solve_triangular(_t(A, transpose), alpha * B,
+                                lower=(not lower) if transpose else lower)
+
+
+@register_op("linalg_trmm", aliases=["_linalg_trmm"])
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0, **kw):
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    tri = _t(tri, transpose)
+    return alpha * (jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B))
+
+
+@register_op("linalg_sumlogdiag", aliases=["_linalg_sumlogdiag"])
+def linalg_sumlogdiag(A, **kw):
+    diag = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(diag), axis=-1)
+
+
+@register_op("linalg_syrk", aliases=["_linalg_syrk"])
+def linalg_syrk(A, transpose=False, alpha=1.0, **kw):
+    a = _t(A, transpose)
+    return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
+
+
+@register_op("linalg_gelqf", aliases=["_linalg_gelqf"], num_outputs=2)
+def linalg_gelqf(A, **kw):
+    """LQ factorization: A = L Q with Q orthonormal rows (reference la_op.cc)."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register_op("linalg_syevd", aliases=["_linalg_syevd"], num_outputs=2)
+def linalg_syevd(A, **kw):
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register_op("khatri_rao")
+def khatri_rao(*args, **kw):
+    """Column-wise Khatri-Rao product (reference: src/operator/contrib/krprod.cc)."""
+    out = args[0]
+    for b in args[1:]:
+        out = jnp.einsum("ik,jk->ijk", out, b).reshape(-1, out.shape[1])
+    return out

@@ -1,0 +1,573 @@
+"""Neural-network operators.
+
+Reference surface: src/operator/nn/ (convolution, fully_connected, pooling,
+batch_norm, layer_norm, softmax, dropout, activation, deconvolution, lrn) and
+src/operator/{rnn,leaky_relu,instance_norm,softmax_output}.
+
+TPU notes: data layout follows the reference's NCHW at the API, but conv and
+pooling are expressed through ``lax.conv_general_dilated`` / ``lax.reduce_window``
+with explicit dimension_numbers so XLA picks MXU-friendly internal layouts.
+bf16 inputs hit the MXU directly. These replace the reference's cuDNN kernels
+(src/operator/nn/cudnn/) — XLA *is* the kernel library.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_op
+from ..dtype import resolve_dtype
+
+
+def _tup(v, n=None):
+    if v is None:
+        return None
+    if isinstance(v, (int, float)):
+        v = (int(v),) * (n or 1)
+    return tuple(int(x) for x in v)
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected (reference: src/operator/nn/fully_connected.cc:228-309)
+# ---------------------------------------------------------------------------
+@register_op("FullyConnected")
+def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True, **kw):
+    x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
+    out = jnp.matmul(x, weight.T)
+    if not no_bias and bias is not None:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution (reference: src/operator/nn/convolution.cc; cuDNN path
+# src/operator/nn/cudnn/cudnn_convolution-inl.h — here: XLA HLO convolution)
+# ---------------------------------------------------------------------------
+def _conv_dnums(ndim):
+    if ndim == 3:
+        return ("NCH", "OIH", "NCH")
+    if ndim == 4:
+        return ("NCHW", "OIHW", "NCHW")
+    return ("NCDHW", "OIDHW", "NCDHW")
+
+
+@register_op("Convolution")
+def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False,
+                cudnn_tune=None, cudnn_off=False, workspace=None, layout=None, **kw):
+    nd = data.ndim
+    sdims = nd - 2
+    stride = _tup(stride, sdims) or (1,) * sdims
+    dilate = _tup(dilate, sdims) or (1,) * sdims
+    pad = _tup(pad, sdims) or (0,) * sdims
+    dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dnums(nd))
+    out = jax.lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], lhs_dilation=None, rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=int(num_group),
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
+    out = out.astype(data.dtype)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * sdims)
+    return out
+
+
+@register_op("Deconvolution")
+def deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                  pad=None, adj=None, target_shape=None, num_filter=None,
+                  num_group=1, no_bias=True, workspace=None, cudnn_tune=None,
+                  cudnn_off=False, layout=None, **kw):
+    """Transposed convolution (reference: src/operator/nn/deconvolution.cc)."""
+    nd = data.ndim
+    sdims = nd - 2
+    stride = _tup(stride, sdims) or (1,) * sdims
+    dilate = _tup(dilate, sdims) or (1,) * sdims
+    pad = _tup(pad, sdims) or (0,) * sdims
+    adj = _tup(adj, sdims) or (0,) * sdims
+    kernel = _tup(kernel, sdims) or weight.shape[2:]
+    # gradient-of-conv formulation: lhs_dilation=stride, flipped spatial pad
+    pads = []
+    for k, p, a, d in zip(kernel, pad, adj, dilate):
+        eff_k = (k - 1) * d + 1
+        pads.append((eff_k - 1 - p, eff_k - 1 - p + a))
+    # weight layout is (Cin, Cout/g, *k) in MXNet deconv; conv wants (O, I, *k)
+    w = jnp.swapaxes(weight, 0, 1)
+    w = jnp.flip(w, axis=tuple(range(2, nd)))
+    if num_group > 1:
+        # regroup: (g, Cout/g, Cin/g, *k) → (Cout, Cin/g, *k)
+        cin = data.shape[1]
+        wg = weight.reshape((num_group, cin // num_group) + weight.shape[1:])
+        wg = jnp.swapaxes(wg, 1, 2)
+        w = wg.reshape((-1, cin // num_group) + weight.shape[2:])
+        w = jnp.flip(w, axis=tuple(range(2, nd)))
+    dn = jax.lax.conv_dimension_numbers(data.shape, w.shape, _conv_dnums(nd))
+    out = jax.lax.conv_general_dilated(
+        data, w, window_strides=(1,) * sdims, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=int(num_group))
+    out = out.astype(data.dtype)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * sdims)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling (reference: src/operator/nn/pooling.cc)
+# ---------------------------------------------------------------------------
+@register_op("Pooling")
+def pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
+            pad=None, pooling_convention="valid", cudnn_off=False,
+            count_include_pad=True, **kw):
+    nd = data.ndim
+    sdims = nd - 2
+    if global_pool:
+        ax = tuple(range(2, nd))
+        if pool_type == "max":
+            return jnp.max(data, axis=ax, keepdims=True)
+        if pool_type == "sum":
+            return jnp.sum(data, axis=ax, keepdims=True)
+        return jnp.mean(data, axis=ax, keepdims=True)
+    kernel = _tup(kernel, sdims)
+    stride = _tup(stride, sdims) or (1,) * sdims
+    pad = _tup(pad, sdims) or (0,) * sdims
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    if pooling_convention == "full":
+        # ceil-mode: pad on the high side so ceil((x+2p-k)/s)+1 windows fit
+        pads = [(0, 0), (0, 0)]
+        for i in range(sdims):
+            x = data.shape[2 + i]
+            out_sz = int(np.ceil((x + 2 * pad[i] - kernel[i]) / stride[i])) + 1
+            need = (out_sz - 1) * stride[i] + kernel[i] - x - pad[i]
+            pads.append((pad[i], max(need, pad[i])))
+    else:
+        pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return jax.lax.reduce_window(data, jnp.asarray(init, data.dtype),
+                                     jax.lax.max, window, strides, pads)
+    summed = jax.lax.reduce_window(data, jnp.asarray(0, data.dtype),
+                                   jax.lax.add, window, strides, pads)
+    if pool_type == "sum":
+        return summed
+    if count_include_pad:
+        denom = np.prod(kernel)
+        return summed / jnp.asarray(denom, data.dtype)
+    ones = jnp.ones(data.shape, data.dtype)
+    counts = jax.lax.reduce_window(ones, jnp.asarray(0, data.dtype),
+                                   jax.lax.add, window, strides, pads)
+    return summed / counts
+
+
+# ---------------------------------------------------------------------------
+# Activations (reference: src/operator/nn/activation.cc, leaky_relu.cc)
+# ---------------------------------------------------------------------------
+@register_op("Activation")
+def activation(data, act_type="relu", **kw):
+    fns = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+           "softrelu": jax.nn.softplus, "softsign": jax.nn.soft_sign}
+    return fns[act_type](data)
+
+
+@register_op("LeakyReLU")
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334, **kw):
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * jnp.expm1(data))
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) if gamma.ndim == 1 else gamma
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data > 0, data, alpha * jnp.expm1(data))
+    if act_type == "rrelu":
+        # inference behavior: use mean slope (reference: leaky_relu-inl.h)
+        s = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data > 0, data, s * data)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+# ---------------------------------------------------------------------------
+# softmax family (reference: src/operator/nn/softmax.cc, softmax_output.cc,
+# loss_binary_op.cc)
+# ---------------------------------------------------------------------------
+@register_op("softmax")
+def softmax(data, axis=-1, temperature=None, length=None, **kw):
+    x = data / temperature if temperature else data
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax")
+def log_softmax(data, axis=-1, temperature=None, **kw):
+    x = data / temperature if temperature else data
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op("SoftmaxActivation")
+def softmax_activation(data, mode="instance", **kw):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+@register_op("softmax_cross_entropy")
+def softmax_cross_entropy(data, label, **kw):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    nll = -jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], axis=-1)
+    return jnp.sum(nll)
+
+
+@register_op("SoftmaxOutput", aliases=["Softmax"])
+def softmax_output(data, label=None, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False, preserve_shape=False,
+                   normalization="null", out_grad=False, smooth_alpha=0.0, **kw):
+    """Forward = softmax; the custom backward (∂=p-y) is realized by pairing
+    with the cross-entropy loss at the framework level (reference:
+    src/operator/softmax_output.cc). Module's fit wires this through
+    ``_softmax_output_loss`` below."""
+    if multi_output:
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data, axis=-1)
+
+
+def softmax_output_loss(data, label, grad_scale=1.0, ignore_label=-1.0,
+                        use_ignore=False, multi_output=False,
+                        normalization="null", smooth_alpha=0.0, **kw):
+    """Cross-entropy whose gradient wrt data equals SoftmaxOutput's backward."""
+    axis = 1 if multi_output else -1
+    logp = jax.nn.log_softmax(data, axis=axis)
+    lab = label.astype(jnp.int32)
+    nll = -jnp.take_along_axis(logp, jnp.expand_dims(lab, axis), axis=axis)
+    nll = jnp.squeeze(nll, axis)
+    if use_ignore:
+        mask = (lab != int(ignore_label)).astype(data.dtype)
+        nll = nll * mask
+        if normalization == "valid":
+            return grad_scale * jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1.0)
+    if normalization == "batch" or normalization == "null":
+        return grad_scale * jnp.mean(nll)
+    return grad_scale * jnp.mean(nll)
+
+
+# ---------------------------------------------------------------------------
+# Normalization (reference: src/operator/nn/batch_norm.cc, layer_norm.cc,
+# src/operator/instance_norm.cc, lrn.cc)
+# ---------------------------------------------------------------------------
+@register_op("BatchNorm", num_outputs=3)
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False, training=False, **kw):
+    """Returns (out, batch_mean, batch_var). Running-stat update is done by the
+    caller (gluon layer / executor) — functional style; the reference mutates
+    aux states in-place (batch_norm.cc)."""
+    ax = axis % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    if training and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+    else:
+        mean, var = moving_mean, moving_var
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    inv = jax.lax.rsqrt(var + eps)
+    out = (data - mean.reshape(bshape)) * (g * inv).reshape(bshape) + beta.reshape(bshape)
+    return out.astype(data.dtype), mean, var
+
+
+@register_op("LayerNorm")
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False, **kw):
+    ax = axis % data.ndim
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    out = (data - mean) * inv * gamma.reshape(bshape) + beta.reshape(bshape)
+    if output_mean_var:
+        return out, jnp.squeeze(mean, ax), jnp.squeeze(var, ax)
+    return out
+
+
+@register_op("InstanceNorm")
+def instance_norm(data, gamma, beta, eps=1e-3, **kw):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * jax.lax.rsqrt(var + eps) * gamma.reshape(bshape) + \
+        beta.reshape(bshape)
+
+
+@register_op("LRN")
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **kw):
+    """Local response norm across channels (reference: src/operator/nn/lrn.cc)."""
+    sq = jnp.square(data)
+    half = nsize // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half)) + ((0, 0),) * (data.ndim - 2))
+    window = jax.lax.reduce_window(
+        padded, jnp.asarray(0, data.dtype), jax.lax.add,
+        (1, nsize) + (1,) * (data.ndim - 2), (1,) * data.ndim,
+        [(0, 0)] * data.ndim)
+    return data / jnp.power(knorm + alpha / nsize * window, beta)
+
+
+# ---------------------------------------------------------------------------
+# Dropout (reference: src/operator/nn/dropout.cc) — needs an RNG key; eager
+# mode uses the global random state, traced mode must pass `key`.
+# ---------------------------------------------------------------------------
+@register_op("Dropout")
+def dropout(data, p=0.5, mode="training", axes=None, key=None, training=None, **kw):
+    from ..random import next_key
+    is_training = training if training is not None else True
+    if not is_training and mode != "always":
+        return data
+    if p <= 0.0:
+        return data
+    if key is None:
+        key = next_key()
+    shape = data.shape
+    if axes:
+        shape = tuple(1 if i in axes else s for i, s in enumerate(shape))
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, shape).astype(data.dtype) / keep
+    return data * mask
+
+
+# ---------------------------------------------------------------------------
+# RNN — fused multi-layer RNN/LSTM/GRU via lax.scan
+# (reference: src/operator/rnn-inl.h + cudnn_rnn-inl.h; the cuDNN fused kernel
+# maps to one scan whose body is MXU matmuls over the whole batch)
+# ---------------------------------------------------------------------------
+def _rnn_gate_count(mode):
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def rnn_unpack_params(params, mode, num_layers, input_size, state_size,
+                      bidirectional=False):
+    """Split the reference's flat cuDNN-layout parameter vector into per-layer
+    (Wx, Wh, bx, bh) (reference layout: rnn-inl.h GetRnnParamSize)."""
+    ngates = _rnn_gate_count(mode)
+    dirs = 2 if bidirectional else 1
+    layers = []
+    off = 0
+    for layer in range(num_layers):
+        for d in range(dirs):
+            isz = input_size if layer == 0 else state_size * dirs
+            wx_n = ngates * state_size * isz
+            wh_n = ngates * state_size * state_size
+            wx = params[off:off + wx_n].reshape(ngates * state_size, isz); off += wx_n
+            wh = params[off:off + wh_n].reshape(ngates * state_size, state_size); off += wh_n
+            layers.append([wx, wh, None, None])
+    for layer in range(num_layers):
+        for d in range(dirs):
+            b_n = ngates * state_size
+            layers[layer * dirs + d][2] = params[off:off + b_n]; off += b_n
+            layers[layer * dirs + d][3] = params[off:off + b_n]; off += b_n
+    return layers
+
+
+def rnn_param_size(mode, num_layers, input_size, state_size, bidirectional=False):
+    ngates = _rnn_gate_count(mode)
+    dirs = 2 if bidirectional else 1
+    total = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else state_size * dirs
+        total += dirs * ngates * state_size * (isz + state_size + 2)
+    return total
+
+
+def _lstm_cell_step(carry, x_t, wx, wh, bx, bh, h):
+    c, hprev = carry
+    gates = x_t @ wx.T + hprev @ wh.T + bx + bh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return (c_new, h_new), h_new
+
+
+def _gru_cell_step(carry, x_t, wx, wh, bx, bh, h):
+    (hprev,) = carry
+    gx = x_t @ wx.T + bx
+    gh = hprev @ wh.T + bh
+    rx, zx, nx = jnp.split(gx, 3, axis=-1)
+    rh, zh, nh = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(rx + rh)
+    z = jax.nn.sigmoid(zx + zh)
+    n = jnp.tanh(nx + r * nh)
+    h_new = (1 - z) * n + z * hprev
+    return (h_new,), h_new
+
+
+def _vanilla_cell_step(act):
+    def step(carry, x_t, wx, wh, bx, bh, h):
+        (hprev,) = carry
+        h_new = act(x_t @ wx.T + hprev @ wh.T + bx + bh)
+        return (h_new,), h_new
+    return step
+
+
+def _run_layer(xs, mode, wx, wh, bx, bh, h0, c0=None, reverse=False):
+    step = {"lstm": _lstm_cell_step, "gru": _gru_cell_step,
+            "rnn_tanh": _vanilla_cell_step(jnp.tanh),
+            "rnn_relu": _vanilla_cell_step(jax.nn.relu)}[mode]
+    init = (c0, h0) if mode == "lstm" else (h0,)
+
+    def body(carry, x_t):
+        return step(carry, x_t, wx, wh, bx, bh, None)
+
+    carry, ys = jax.lax.scan(body, init, xs, reverse=reverse)
+    return carry, ys
+
+
+@register_op("RNN", num_outputs=-1)
+def rnn(data, parameters, state, state_cell=None, state_size=None,
+        num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+        state_outputs=False, lstm_state_clip_min=None, lstm_state_clip_max=None,
+        lstm_state_clip_nan=False, **kw):
+    """Fused RNN (reference: src/operator/rnn-inl.h, data layout (T, N, C);
+    state (L*dirs, N, H)). Implemented as stacked ``lax.scan`` — the TPU-native
+    replacement of the cuDNN fused RNN kernel."""
+    T, N, C = data.shape
+    dirs = 2 if bidirectional else 1
+    layers = rnn_unpack_params(parameters, mode, num_layers, C, state_size,
+                               bidirectional)
+    xs = data
+    h_out, c_out = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(dirs):
+            li = layer * dirs + d
+            wx, wh, bx, bh = layers[li]
+            h0 = state[li]
+            c0 = state_cell[li] if mode == "lstm" else None
+            carry, ys = _run_layer(xs, mode, wx, wh, bx, bh, h0, c0,
+                                   reverse=(d == 1))
+            outs.append(ys)
+            if mode == "lstm":
+                c_out.append(carry[0]); h_out.append(carry[1])
+            else:
+                h_out.append(carry[0])
+        xs = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+    out = xs
+    if state_outputs:
+        hs = jnp.stack(h_out)
+        if mode == "lstm":
+            return out, hs, jnp.stack(c_out)
+        return out, hs
+    return out
+
+
+# ---------------------------------------------------------------------------
+# misc vision ops
+# ---------------------------------------------------------------------------
+@register_op("UpSampling")
+def upsampling(*args, scale=1, sample_type="nearest", num_args=1, num_filter=0,
+               multi_input_mode="concat", workspace=None, **kw):
+    data = args[0]
+    if sample_type == "nearest":
+        if num_args > 1 and multi_input_mode == "concat":
+            outs = [jnp.repeat(jnp.repeat(a, scale, axis=2), scale, axis=3)
+                    for a in args]
+            return jnp.concatenate(outs, axis=1)
+        return jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    # bilinear = deconvolution with bilinear kernel (args[1])
+    weight = args[1]
+    pad = scale // 2
+    return deconvolution(data, weight, None, kernel=(scale * 2 - scale % 2,) * 2,
+                         stride=(scale,) * 2, pad=(pad,) * 2,
+                         num_filter=data.shape[1], num_group=data.shape[1],
+                         no_bias=True)
+
+
+@register_op("ROIPooling")
+def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0, **kw):
+    """Reference: src/operator/roi_pooling.cc. Vectorized over rois."""
+    ph, pw = _tup(pooled_size, 2)
+    N, C, H, W = data.shape
+
+    def one_roi(roi):
+        batch_idx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = data[batch_idx]  # (C,H,W)
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+
+        def pool_cell(iy, ix):
+            hstart = y1 + (iy * rh) // ph
+            hend = y1 + ((iy + 1) * rh + ph - 1) // ph
+            wstart = x1 + (ix * rw) // pw
+            wend = x1 + ((ix + 1) * rw + pw - 1) // pw
+            mask = ((ys[:, None] >= hstart) & (ys[:, None] < hend) &
+                    (xs[None, :] >= wstart) & (xs[None, :] < wend))
+            masked = jnp.where(mask[None], img, -jnp.inf)
+            val = jnp.max(masked, axis=(1, 2))
+            return jnp.where(jnp.isfinite(val), val, 0.0)
+
+        grid = jax.vmap(lambda iy: jax.vmap(lambda ix: pool_cell(iy, ix))(
+            jnp.arange(pw)))(jnp.arange(ph))  # (ph, pw, C)
+        return jnp.transpose(grid, (2, 0, 1))
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register_op("GridGenerator", no_grad=True)
+def grid_generator(data, transform_type="affine", target_shape=(0, 0), **kw):
+    h, w = target_shape
+    ys = jnp.linspace(-1, 1, h)
+    xs = jnp.linspace(-1, 1, w)
+    gx, gy = jnp.meshgrid(xs, ys)
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, h*w)
+    if transform_type == "affine":
+        theta = data.reshape(-1, 2, 3)
+        out = jnp.einsum("nij,jk->nik", theta, base)
+        return out.reshape(-1, 2, h, w)
+    return data + jnp.stack([gx, gy])[None]
+
+
+@register_op("BilinearSampler")
+def bilinear_sampler(data, grid, **kw):
+    """Reference: src/operator/bilinear_sampler.cc. grid in [-1,1], (N,2,H,W)."""
+    N, C, H, W = data.shape
+    _, _, outH, outW = grid.shape
+    gx = (grid[:, 0] + 1) * (W - 1) / 2
+    gy = (grid[:, 1] + 1) * (H - 1) / 2
+
+    x0 = jnp.floor(gx); y0 = jnp.floor(gy)
+    x1, y1 = x0 + 1, y0 + 1
+    wx1 = gx - x0; wy1 = gy - y0
+    wx0 = 1 - wx1; wy0 = 1 - wy1
+
+    def sample(img, xi, yi):
+        xi_c = jnp.clip(xi.astype(jnp.int32), 0, W - 1)
+        yi_c = jnp.clip(yi.astype(jnp.int32), 0, H - 1)
+        valid = ((xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1))
+        vals = img[:, yi_c, xi_c]  # (C, outH, outW)
+        return vals * valid[None]
+
+    def per_image(img, x0i, y0i, x1i, y1i, w00, w01, w10, w11):
+        return (sample(img, x0i, y0i) * w00[None] + sample(img, x1i, y0i) * w01[None]
+                + sample(img, x0i, y1i) * w10[None] + sample(img, x1i, y1i) * w11[None])
+
+    return jax.vmap(per_image)(data, x0, y0, x1, y1,
+                               wy0 * wx0, wy0 * wx1, wy1 * wx0, wy1 * wx1)
+
+
+@register_op("SpatialTransformer")
+def spatial_transformer(data, loc, target_shape=(0, 0), transform_type="affine",
+                        sampler_type="bilinear", cudnn_off=False, **kw):
+    grid = grid_generator(loc, transform_type, target_shape)
+    return bilinear_sampler(data, grid)

@@ -1,0 +1,112 @@
+"""Random sampling operators.
+
+Reference surface: src/operator/random/sample_op.cc (uniform/normal/gamma/
+exponential/poisson/negative_binomial), multisample_op.cc, shuffle_op.cc,
+unique_sample_op.cc. Eager calls draw from the global key
+(mxnet_tpu.random); under jit pass ``key=`` explicitly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from ..dtype import resolve_dtype
+from ..random import next_key
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+@register_op("_random_uniform", aliases=["random_uniform", "uniform"], no_grad=True)
+def random_uniform(low=0.0, high=1.0, shape=None, ctx=None, dtype="float32",
+                   key=None, **kw):
+    key = key if key is not None else next_key()
+    return jax.random.uniform(key, _shape(shape), resolve_dtype(dtype), low, high)
+
+
+@register_op("_random_normal", aliases=["random_normal", "normal"], no_grad=True)
+def random_normal(loc=0.0, scale=1.0, shape=None, ctx=None, dtype="float32",
+                  key=None, **kw):
+    key = key if key is not None else next_key()
+    return loc + scale * jax.random.normal(key, _shape(shape), resolve_dtype(dtype))
+
+
+@register_op("_random_gamma", aliases=["random_gamma"], no_grad=True)
+def random_gamma(alpha=1.0, beta=1.0, shape=None, ctx=None, dtype="float32",
+                 key=None, **kw):
+    key = key if key is not None else next_key()
+    return jax.random.gamma(key, alpha, _shape(shape), resolve_dtype(dtype)) * beta
+
+
+@register_op("_random_exponential", aliases=["random_exponential"], no_grad=True)
+def random_exponential(lam=1.0, shape=None, ctx=None, dtype="float32", key=None, **kw):
+    key = key if key is not None else next_key()
+    return jax.random.exponential(key, _shape(shape), resolve_dtype(dtype)) / lam
+
+
+@register_op("_random_poisson", aliases=["random_poisson"], no_grad=True)
+def random_poisson(lam=1.0, shape=None, ctx=None, dtype="float32", key=None, **kw):
+    key = key if key is not None else next_key()
+    return jax.random.poisson(key, lam, _shape(shape)).astype(resolve_dtype(dtype))
+
+
+@register_op("_random_negative_binomial", aliases=["random_negative_binomial"],
+             no_grad=True)
+def random_negative_binomial(k=1, p=1.0, shape=None, ctx=None, dtype="float32",
+                             key=None, **kw):
+    key = key if key is not None else next_key()
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam, _shape(shape)).astype(resolve_dtype(dtype))
+
+
+@register_op("_random_generalized_negative_binomial",
+             aliases=["random_generalized_negative_binomial"], no_grad=True)
+def random_gen_neg_binomial(mu=1.0, alpha=1.0, shape=None, ctx=None,
+                            dtype="float32", key=None, **kw):
+    key = key if key is not None else next_key()
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam, _shape(shape)).astype(resolve_dtype(dtype))
+
+
+@register_op("_sample_multinomial", aliases=["sample_multinomial", "multinomial"],
+             no_grad=True)
+def sample_multinomial(data, shape=None, get_prob=False, dtype="int32", key=None, **kw):
+    key = key if key is not None else next_key()
+    n = 1 if not shape else (shape if isinstance(shape, int) else int(jnp.prod(jnp.asarray(shape))))
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    samples = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(n,) + data.shape[:-1])
+    samples = jnp.moveaxis(samples, 0, -1)
+    if n == 1 and not shape:
+        samples = samples[..., 0]
+    samples = samples.astype(resolve_dtype(dtype))
+    if get_prob:
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits), samples.astype(jnp.int32)[..., None], -1)
+        return samples, logp[..., 0]
+    return samples
+
+
+@register_op("_shuffle", aliases=["shuffle"], no_grad=True)
+def shuffle(data, key=None, **kw):
+    key = key if key is not None else next_key()
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register_op("_sample_unique_zipfian", no_grad=True)
+def sample_unique_zipfian(range_max=1, shape=None, key=None, **kw):
+    key = key if key is not None else next_key()
+    n = shape[0] if isinstance(shape, (tuple, list)) else int(shape)
+    u = jax.random.uniform(key, (n,))
+    s = jnp.exp(u * jnp.log(float(range_max) + 1.0)) - 1.0
+    return s.astype(jnp.int64) % range_max

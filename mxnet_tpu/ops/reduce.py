@@ -1,0 +1,105 @@
+"""Reduction operators.
+
+Reference surface: src/operator/tensor/broadcast_reduce_op_value.cc,
+broadcast_reduce_op_index.cc (sum/mean/prod/max/min/norm/argmax/argmin with
+``axis``/``keepdims``/``exclude`` semantics).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op, alias
+
+
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None:
+        return None
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a % ndim for a in axis)
+    if exclude:
+        axis = tuple(a for a in range(ndim) if a not in axis)
+    return axis
+
+
+def _reduce(fn):
+    def impl(data, axis=None, keepdims=False, exclude=False, **kw):
+        ax = _norm_axis(axis, data.ndim, exclude)
+        return fn(data, axis=ax, keepdims=bool(keepdims))
+    return impl
+
+
+register_op("sum", aliases=["sum_axis"])(_reduce(jnp.sum))
+register_op("mean")(_reduce(jnp.mean))
+register_op("prod")(_reduce(jnp.prod))
+register_op("nansum")(_reduce(jnp.nansum))
+register_op("nanprod")(_reduce(jnp.nanprod))
+register_op("max", aliases=["max_axis"])(_reduce(jnp.max))
+register_op("min", aliases=["min_axis"])(_reduce(jnp.min))
+
+
+@register_op("norm")
+def norm(data, ord=2, axis=None, keepdims=False, **kw):
+    ax = _norm_axis(axis, data.ndim)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=bool(keepdims))
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=bool(keepdims)))
+
+
+def _index_reduce(fn):
+    def impl(data, axis=None, keepdims=False, **kw):
+        out = fn(data, axis=axis)
+        if keepdims and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        # reference returns float indices (mshadow legacy)
+        return out.astype(jnp.float32)
+    return impl
+
+
+register_op("argmax", no_grad=True)(_index_reduce(jnp.argmax))
+register_op("argmin", no_grad=True)(_index_reduce(jnp.argmin))
+
+
+@register_op("argmax_channel", no_grad=True)
+def argmax_channel(data, **kw):
+    """argmax over axis 1 (reference: broadcast_reduce_op_index.cc)."""
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+@register_op("pick")
+def pick(data, index, axis=-1, keepdims=False, mode="clip", **kw):
+    """Pick elements along an axis by index (reference:
+    src/operator/tensor/broadcast_reduce_op_index.cc pick)."""
+    axis = axis % data.ndim
+    idx = index.astype(jnp.int32)
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, data.shape[axis] - 1)
+    else:
+        idx = idx % data.shape[axis]
+    picked = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    if not keepdims:
+        picked = jnp.squeeze(picked, axis=axis)
+    return picked
+
+
+# broadcasting "expand" ops live with reductions in the reference
+@register_op("broadcast_to")
+def broadcast_to(data, shape=None, **kw):
+    shape = tuple(shape)
+    tgt = tuple(s if s != 0 else d for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register_op("broadcast_axis", aliases=["broadcast_axes"])
+def broadcast_axis(data, axis=(), size=(), **kw):
+    if isinstance(axis, int):
+        axis, size = (axis,), (size,)
+    tgt = list(data.shape)
+    for a, s in zip(axis, size):
+        tgt[a % data.ndim] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register_op("broadcast_like")
+def broadcast_like(lhs, rhs, **kw):
+    return jnp.broadcast_to(lhs, rhs.shape)

@@ -1,0 +1,330 @@
+"""Shape manipulation, indexing, joining and misc tensor ops.
+
+Reference surface: src/operator/tensor/matrix_op.cc (reshape/transpose/slice/
+clip/repeat/tile/flip/...), indexing_op.cc (take/one_hot/gather_nd/scatter_nd),
+concat.cc, slice_channel.cc, stack, pad.cc, cast, depth-space ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_op, alias
+from ..dtype import resolve_dtype
+
+
+@register_op("Reshape", aliases=["reshape"])
+def reshape(data, shape=None, reverse=False, **kw):
+    """MXNet reshape incl. special codes 0 (copy dim), -1 (infer), -2 (copy
+    rest), -3 (merge two dims), -4 (split dim) — reference: matrix_op.cc
+    ReshapeShape."""
+    if shape is None:
+        return data
+    shape = tuple(shape)
+    src = list(data.shape)
+    if reverse:
+        src = src[::-1]
+        shape = tuple(reversed(shape))
+    out = []
+    src_i = 0
+    i = 0
+    while i < len(shape):
+        s = shape[i]
+        if s == 0:
+            out.append(src[src_i]); src_i += 1
+        elif s == -1:
+            out.append(-1); src_i += 1
+        elif s == -2:
+            out.extend(src[src_i:]); src_i = len(src)
+        elif s == -3:
+            out.append(src[src_i] * src[src_i + 1]); src_i += 2
+        elif s == -4:
+            a, b = shape[i + 1], shape[i + 2]
+            dim = src[src_i]
+            if a == -1:
+                a = dim // b
+            if b == -1:
+                b = dim // a
+            out.extend([a, b]); src_i += 1; i += 2
+        else:
+            out.append(s); src_i += 1
+        i += 1
+    if reverse:
+        out = out[::-1]
+    return jnp.reshape(data, tuple(out))
+
+
+@register_op("Flatten", aliases=["flatten"])
+def flatten(data, **kw):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register_op("transpose")
+def transpose(data, axes=None, **kw):
+    axes = tuple(axes) if axes else None
+    return jnp.transpose(data, axes)
+
+
+@register_op("expand_dims")
+def expand_dims(data, axis=0, **kw):
+    return jnp.expand_dims(data, axis)
+
+
+@register_op("squeeze")
+def squeeze(data, axis=None, **kw):
+    return jnp.squeeze(data, axis if axis is None else tuple(
+        axis if isinstance(axis, (tuple, list)) else (axis,)))
+
+
+@register_op("SwapAxis", aliases=["swapaxes"])
+def swapaxes(data, dim1=0, dim2=0, **kw):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register_op("slice", aliases=["crop"])
+def slice_op(data, begin=(), end=(), step=(), **kw):
+    """Reference: matrix_op.cc Slice; begin/end entries may be None."""
+    slices = []
+    step = step or (None,) * len(begin)
+    for b, e, s in zip(begin, end, step):
+        slices.append(slice(b, e, s))
+    return data[tuple(slices)]
+
+
+@register_op("slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None, **kw):
+    axis = axis % data.ndim
+    sl = [slice(None)] * data.ndim
+    sl[axis] = slice(begin, end)
+    return data[tuple(sl)]
+
+
+@register_op("slice_like")
+def slice_like(data, shape_like, axes=(), **kw):
+    axes = tuple(axes) if axes else tuple(range(shape_like.ndim))
+    sl = [slice(None)] * data.ndim
+    for a in axes:
+        sl[a % data.ndim] = slice(0, shape_like.shape[a % shape_like.ndim])
+    return data[tuple(sl)]
+
+
+@register_op("clip")
+def clip(data, a_min=None, a_max=None, **kw):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register_op("take")
+def take(a, indices, axis=0, mode="clip", **kw):
+    idx = indices.astype(jnp.int32)
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    elif mode == "wrap":
+        idx = idx % a.shape[axis]
+    return jnp.take(a, idx, axis=axis)
+
+
+@register_op("batch_take")
+def batch_take(a, indices, **kw):
+    idx = indices.astype(jnp.int32).reshape(-1)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@register_op("Embedding")
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
+              sparse_grad=False, **kw):
+    """Reference: src/operator/tensor/indexing_op.cc Embedding. On TPU this is
+    a gather that XLA lowers natively; sparse_grad is advisory."""
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register_op("one_hot", no_grad=True)
+def one_hot(indices, depth=None, on_value=1.0, off_value=0.0, dtype="float32", **kw):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+    out = oh * on_value + (1.0 - oh) * off_value
+    return out.astype(resolve_dtype(dtype))
+
+
+@register_op("gather_nd")
+def gather_nd(data, indices, **kw):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register_op("scatter_nd")
+def scatter_nd(data, indices, shape=None, **kw):
+    out = jnp.zeros(tuple(shape), data.dtype)
+    idx = tuple(indices.astype(jnp.int32))
+    return out.at[idx].set(data)
+
+
+@register_op("Concat", aliases=["concat"])
+def concat(*args, dim=1, num_args=None, **kw):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register_op("stack")
+def stack(*args, axis=0, num_args=None, **kw):
+    return jnp.stack(args, axis=axis)
+
+
+@register_op("SliceChannel", aliases=["split"], num_outputs=-1)
+def split(data, num_outputs=2, axis=1, squeeze_axis=False, **kw):
+    outs = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        outs = [jnp.squeeze(o, axis=axis) for o in outs]
+    return tuple(outs)
+
+
+@register_op("tile")
+def tile(data, reps=(), **kw):
+    return jnp.tile(data, tuple(reps))
+
+
+@register_op("repeat")
+def repeat(data, repeats=1, axis=None, **kw):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register_op("reverse", aliases=["flip"])
+def reverse(data, axis=(), **kw):
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.flip(data, axis=tuple(axis))
+
+
+@register_op("Pad", aliases=["pad"])
+def pad(data, mode="constant", pad_width=(), constant_value=0.0, **kw):
+    pw = tuple(pad_width)
+    pairs = tuple((pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2))
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(data, pairs, mode="constant", constant_values=constant_value)
+    return jnp.pad(data, pairs, mode=jmode)
+
+
+@register_op("where")
+def where(condition, x, y, **kw):
+    return jnp.where(condition != 0 if condition.dtype != jnp.bool_ else condition, x, y)
+
+
+@register_op("Cast", aliases=["cast"], no_grad=False)
+def cast(data, dtype="float32", **kw):
+    return data.astype(resolve_dtype(dtype))
+
+
+@register_op("zeros_like", no_grad=True)
+def zeros_like(data, **kw):
+    return jnp.zeros_like(data)
+
+
+@register_op("ones_like", no_grad=True)
+def ones_like(data, **kw):
+    return jnp.ones_like(data)
+
+
+@register_op("shape_array", no_grad=True)
+def shape_array(data, **kw):
+    return jnp.asarray(data.shape, jnp.int64)
+
+
+@register_op("size_array", no_grad=True)
+def size_array(data, **kw):
+    return jnp.asarray([data.size], jnp.int64)
+
+
+@register_op("diag")
+def diag(data, k=0, **kw):
+    return jnp.diag(data, k=k) if data.ndim <= 2 else jnp.diagonal(data, offset=k)
+
+
+@register_op("depth_to_space")
+def depth_to_space(data, block_size=1, **kw):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register_op("space_to_depth")
+def space_to_depth(data, block_size=1, **kw):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register_op("dot")
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, **kw):
+    """Reference: src/operator/tensor/dot.cc — contracts lhs's last axis with
+    rhs's first (NOT numpy matmul semantics for ndim>2)."""
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    return jnp.tensordot(a, b, axes=1)
+
+
+@register_op("batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, **kw):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register_op("L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance", **kw):
+    """Reference: src/operator/l2_normalization.cc."""
+    if mode == "instance":
+        ax = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    else:  # spatial
+        ax = tuple(range(2, data.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=True) + eps)
+    return data / norm
+
+
+@register_op("sequence_mask", aliases=["SequenceMask"])
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0, **kw):
+    """Reference: src/operator/sequence_mask.cc. data is (T,N,...) for axis=0."""
+    if not use_sequence_length or sequence_length is None:
+        return data
+    T = data.shape[axis]
+    pos = jnp.arange(T)
+    # broadcast positions against (N,) lengths
+    if axis == 0:
+        mask = pos[:, None] < sequence_length[None, :].astype(jnp.int32)
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    else:  # axis == 1: (N, T, ...)
+        mask = pos[None, :] < sequence_length[:, None].astype(jnp.int32)
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register_op("sequence_last", aliases=["SequenceLast"])
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0, **kw):
+    if not use_sequence_length or sequence_length is None:
+        idx = data.shape[axis] - 1
+        return jnp.take(data, idx, axis=axis)
+    last = (sequence_length.astype(jnp.int32) - 1)
+    moved = jnp.moveaxis(data, axis, 0)  # (T, N, ...)
+    gathered = jnp.take_along_axis(
+        moved, last.reshape((1, -1) + (1,) * (moved.ndim - 2)), axis=0)
+    return jnp.squeeze(gathered, axis=0)
+
+
+@register_op("sequence_reverse", aliases=["SequenceReverse"])
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                     axis=0, **kw):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    T = data.shape[0]
+    lens = sequence_length.astype(jnp.int32)
+    pos = jnp.arange(T)[:, None]
+    rev_idx = jnp.where(pos < lens[None, :], lens[None, :] - 1 - pos, pos)
+    moved = data  # (T, N, ...)
+    idx = rev_idx.reshape(rev_idx.shape + (1,) * (moved.ndim - 2))
+    return jnp.take_along_axis(moved, idx, axis=0)

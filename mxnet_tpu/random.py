@@ -1,0 +1,39 @@
+"""Global random state.
+
+Reference: src/resource.cc seeded ``mshadow::Random`` per device +
+python/mxnet/random.py. JAX RNG is functional (explicit keys); the eager
+frontend keeps a global splitting key so `mx.random.seed(n)` reproduces runs,
+while jitted/pjitted code takes explicit keys (idiomatic TPU style).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "current_key"]
+
+_lock = threading.Lock()
+_key = [None]  # lazy: creating a key at import time would init the backend
+
+
+def seed(seed_state: int, ctx="all"):
+    """Seed the global generator (reference: python/mxnet/random.py:28)."""
+    with _lock:
+        _key[0] = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split and return a fresh subkey (thread-safe)."""
+    with _lock:
+        if _key[0] is None:
+            _key[0] = jax.random.PRNGKey(0)
+        _key[0], sub = jax.random.split(_key[0])
+    return sub
+
+
+def current_key():
+    with _lock:
+        if _key[0] is None:
+            _key[0] = jax.random.PRNGKey(0)
+        return _key[0]

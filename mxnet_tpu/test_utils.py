@@ -1,0 +1,117 @@
+"""Test fixtures and numeric checking helpers.
+
+Rebuild of the reference's central fixture library
+(reference: python/mxnet/test_utils.py — assert_almost_equal:470,
+check_numeric_gradient:792, check_symbolic_forward/backward:925,
+check_consistency:1207, default_context:53, rand_ndarray:339).
+
+The CPU↔GPU consistency harness becomes CPU-jax ↔ TPU-jax consistency.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd
+from .context import Context, cpu, current_context
+from .ndarray import NDArray
+
+_DEFAULT_CTX = [None]
+
+
+def default_context() -> Context:
+    return _DEFAULT_CTX[0] if _DEFAULT_CTX[0] is not None else current_context()
+
+
+def set_default_context(ctx: Context):
+    _DEFAULT_CTX[0] = ctx
+
+
+def default_dtype():
+    return np.float32
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None, ctx=None):
+    arr = np.random.uniform(-1.0, 1.0, shape).astype(dtype or np.float32)
+    if stype == "default":
+        return nd.array(arr, ctx=ctx)
+    from .ndarray.sparse import array as sparse_array
+    if density is not None:
+        mask = np.random.uniform(0, 1, (shape[0],) + (1,) * (len(arr.shape) - 1))
+        arr = arr * (mask < density)
+    return sparse_array(arr, stype, ctx=ctx)
+
+
+def same(a, b):
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20):
+    return np.allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-6, names=("a", "b")):
+    """Reference: test_utils.py:470."""
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                               err_msg=f"{names[0]} vs {names[1]}")
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-4):
+    """Finite-difference gradient check of an NDArray function.
+
+    ``fn(*ndarrays) -> scalar NDArray``. Analytic gradients come from the
+    autograd tape; numeric from central differences
+    (reference: test_utils.py:792 — same method, numpy-side).
+    """
+    from . import autograd
+
+    inputs = [x if isinstance(x, NDArray) else nd.array(x) for x in inputs]
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*inputs)
+    out.backward()
+    analytic = [x.grad.asnumpy().copy() for x in inputs]
+
+    for idx, x in enumerate(inputs):
+        base = x.asnumpy().astype(np.float64)
+        num_grad = np.zeros_like(base)
+        flat = base.reshape(-1)
+        ng_flat = num_grad.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            fp = float(fn(*[nd.array(base.astype(np.float32)) if j == idx else inputs[j]
+                            for j in range(len(inputs))]).asscalar())
+            flat[i] = orig - eps
+            fm = float(fn(*[nd.array(base.astype(np.float32)) if j == idx else inputs[j]
+                            for j in range(len(inputs))]).asscalar())
+            flat[i] = orig
+            ng_flat[i] = (fp - fm) / (2 * eps)
+        np.testing.assert_allclose(analytic[idx], num_grad, rtol=rtol, atol=atol,
+                                   err_msg=f"gradient mismatch for input {idx}")
+
+
+def check_consistency(fn, inputs, ctx_list=None, rtol=1e-4, atol=1e-5):
+    """Run the same computation on every context and cross-compare
+    (reference: test_utils.py:1207 — CPU↔GPU; here CPU↔TPU)."""
+    import jax
+    ctxs = ctx_list or [cpu(0)]
+    outs = []
+    for ctx in ctxs:
+        placed = [x.as_in_context(ctx) for x in inputs]
+        out = fn(*placed)
+        outs.append(out.asnumpy())
+    for o in outs[1:]:
+        np.testing.assert_allclose(outs[0], o, rtol=rtol, atol=atol)
+    return outs
+
+
+def list_gpus():
+    from .context import num_gpus
+    return list(range(num_gpus()))

@@ -1,0 +1,32 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-chip TPU hardware is not available in CI; sharding/collective tests run
+on a virtual 8-device CPU mesh exactly as the driver's dryrun does. The TPU
+execution path itself is exercised by bench.py on the real chip.
+
+Note: the environment's sitecustomize registers the 'axon' TPU platform and
+sets jax_platforms to "axon,cpu"; we override it back to cpu before any
+backend initializes.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("MXTPU_TEST_PLATFORM", "cpu"))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    import mxnet_tpu as mx
+    np.random.seed(0)
+    mx.random.seed(0)
+    yield

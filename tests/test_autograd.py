@@ -1,0 +1,170 @@
+"""Autograd tests (model: reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, [2.0, 4.0, 6.0])
+
+
+def test_chain():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(nd.log(x) * 2.0)  # x^2
+        z = y.sum()
+    z.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy(), rtol=1e-4)
+
+
+def test_multi_input():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b + a).sum()
+    c.backward()
+    assert_almost_equal(a.grad, [4.0, 5.0])
+    assert_almost_equal(b.grad, [1.0, 2.0])
+
+
+def test_reused_variable():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x  # two tape nodes sharing x
+    y.backward()
+    assert_almost_equal(x.grad, [12.0])
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3.0
+    y.backward(nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, [30.0, 300.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2.0
+        y.backward()
+    assert_almost_equal(x.grad, [6.0])
+
+
+def test_grad_req_write_resets():
+    x = nd.array([1.0])
+    x.attach_grad()  # write
+    for _ in range(2):
+        with autograd.record():
+            y = x * 2.0
+        y.backward()
+    assert_almost_equal(x.grad, [2.0])
+
+
+def test_pause_and_flags():
+    x = nd.array([1.0])
+    x.attach_grad()
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+            z = x * 5.0
+        y = x * 2.0
+    y.backward()
+    assert z._node is None
+    assert_almost_equal(x.grad, [2.0])
+
+
+def test_detach():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, [9.0])  # only d(z)/dx through the last x
+
+
+def test_matmul_grad():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 2).astype(np.float32)
+    x, w = nd.array(a), nd.array(b)
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        out = nd.dot(x, w).sum()
+    out.backward()
+    assert_almost_equal(x.grad, np.ones((3, 2)) @ b.T, rtol=1e-4)
+    assert_almost_equal(w.grad, a.T @ np.ones((3, 2)), rtol=1e-4)
+
+
+def test_autograd_grad_function():
+    x = nd.array([1.0, 2.0])
+    with autograd.record():
+        y = (x * x).sum()
+    g = autograd.grad(y, x)
+    assert_almost_equal(g, [2.0, 4.0])
+    assert x.grad is None or not x._require_grad  # state restored
+
+
+def test_mark_variables():
+    x = nd.array([2.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables(x, g)
+    with autograd.record():
+        y = x * 7.0
+    y.backward()
+    assert_almost_equal(x.grad, [7.0])
+
+
+def test_numeric_gradient_check():
+    check_numeric_gradient(lambda x: (nd.tanh(x) * x).sum(),
+                           [np.random.rand(2, 3).astype(np.float32)])
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = nd.array([0.5, -1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad, s * (1 - s), rtol=1e-5)
+
+
+def test_training_mode_dropout():
+    x = nd.ones((100,))
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    assert not np.allclose(y.asnumpy(), x.asnumpy())  # masked
+    with autograd.record(train_mode=False):
+        y2 = nd.Dropout(x, p=0.5)
+    assert_almost_equal(y2, x)

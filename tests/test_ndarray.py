@@ -1,0 +1,205 @@
+"""NDArray core tests (model: reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    x = nd.zeros((2, 3))
+    assert x.shape == (2, 3)
+    assert x.dtype == np.float32
+    assert_almost_equal(x, np.zeros((2, 3)))
+
+    y = nd.ones((4,), dtype="int32")
+    assert y.dtype == np.int32
+
+    z = nd.full((2, 2), 7.5)
+    assert_almost_equal(z, np.full((2, 2), 7.5))
+
+    a = nd.arange(0, 10, 2)
+    assert_almost_equal(a, np.arange(0, 10, 2, dtype=np.float32))
+
+    b = nd.array([[1, 2], [3, 4]])
+    assert b.shape == (2, 2)
+    # float64 input downcasts to float32 (MXNet default-dtype semantics)
+    c = nd.array(np.random.rand(3, 3))
+    assert c.dtype == np.float32
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    assert_almost_equal(a + b, [[11, 22], [33, 44]])
+    assert_almost_equal(a - b, [[-9, -18], [-27, -36]])
+    assert_almost_equal(a * b, [[10, 40], [90, 160]])
+    assert_almost_equal(b / a, [[10, 10], [10, 10]])
+    assert_almost_equal(a + 1, [[2, 3], [4, 5]])
+    assert_almost_equal(1 - a, [[0, -1], [-2, -3]])
+    assert_almost_equal(2 * a, [[2, 4], [6, 8]])
+    assert_almost_equal(8 / a, [[8, 4], [8 / 3, 2]])
+    assert_almost_equal(a ** 2, [[1, 4], [9, 16]])
+    assert_almost_equal(-a, [[-1, -2], [-3, -4]])
+    assert_almost_equal(abs(-a), [[1, 2], [3, 4]])
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    a += 1
+    assert_almost_equal(a, np.full((2, 2), 2.0))
+    a *= 3
+    assert_almost_equal(a, np.full((2, 2), 6.0))
+    a /= 2
+    assert_almost_equal(a, np.full((2, 2), 3.0))
+    a -= 1
+    assert_almost_equal(a, np.full((2, 2), 2.0))
+
+
+def test_comparisons():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    assert_almost_equal(a == b, [0, 1, 0])
+    assert_almost_equal(a != b, [1, 0, 1])
+    assert_almost_equal(a > b, [0, 0, 1])
+    assert_almost_equal(a >= 2, [0, 1, 1])
+    assert_almost_equal(a < b, [1, 0, 0])
+    assert_almost_equal(a <= 2, [1, 1, 0])
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert_almost_equal(a[0], np.arange(12).reshape(3, 4))
+    assert_almost_equal(a[1, 2], [20, 21, 22, 23])
+    assert_almost_equal(a[:, 1, :2], [[4, 5], [16, 17]])
+    a[0, 0, 0] = 99
+    assert a[0, 0, 0].asscalar() == 99
+    a[:] = 0
+    assert_almost_equal(a, np.zeros((2, 3, 4)))
+
+
+def test_reshape_specials():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((2, -4, 1, 3, 4)).shape == (2, 1, 3, 4)
+    assert a.reshape(6, 4).shape == (6, 4)
+
+
+def test_reduce():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(a.sum(), x.sum())
+    assert_almost_equal(a.sum(axis=1), x.sum(axis=1))
+    assert_almost_equal(a.mean(axis=(0, 2)), x.mean(axis=(0, 2)))
+    assert_almost_equal(a.max(axis=2), x.max(axis=2))
+    assert_almost_equal(a.min(), x.min())
+    assert_almost_equal(nd.sum(a, axis=1, exclude=True), x.sum(axis=(0, 2)))
+    assert_almost_equal(a.argmax(axis=1), x.argmax(axis=1))
+    assert_almost_equal(a.norm(), np.sqrt((x ** 2).sum()), rtol=1e-4)
+
+
+def test_shape_ops():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(a.T, x.T)
+    assert_almost_equal(a.transpose((2, 0, 1)), x.transpose(2, 0, 1))
+    assert_almost_equal(nd.expand_dims(a, axis=1), x[:, None])
+    assert_almost_equal(a.flatten(), x.reshape(2, -1))
+    assert_almost_equal(nd.concat(a, a, dim=2), np.concatenate([x, x], axis=2))
+    assert_almost_equal(nd.stack(a, a, axis=0), np.stack([x, x]))
+    outs = nd.split(a, num_outputs=3, axis=1)
+    assert len(outs) == 3
+    assert_almost_equal(outs[1], x[:, 1:2, :])
+    assert_almost_equal(nd.tile(a, reps=(1, 2, 1)), np.tile(x, (1, 2, 1)))
+    assert_almost_equal(nd.flip(a, axis=2), x[:, :, ::-1])
+    assert_almost_equal(nd.slice_axis(a, axis=2, begin=1, end=3), x[:, :, 1:3])
+    assert_almost_equal(nd.where(nd.array([1.0, 0.0]), nd.array([1.0, 2.0]),
+                                 nd.array([3.0, 4.0])), [1, 4])
+
+
+def test_dot():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 5).astype(np.float32)
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b)), a @ b, rtol=1e-4)
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b.T), transpose_b=True),
+                        a @ b, rtol=1e-4)
+    ba = np.random.rand(2, 3, 4).astype(np.float32)
+    bb = np.random.rand(2, 4, 5).astype(np.float32)
+    assert_almost_equal(nd.batch_dot(nd.array(ba), nd.array(bb)), ba @ bb,
+                        rtol=1e-4)
+
+
+def test_take_onehot_pick():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    idx = nd.array([0, 2])
+    assert_almost_equal(nd.take(a, idx), np.arange(12).reshape(3, 4)[[0, 2]])
+    oh = nd.one_hot(nd.array([0, 2]), depth=3)
+    assert_almost_equal(oh, [[1, 0, 0], [0, 0, 1]])
+    p = nd.pick(a, nd.array([1, 0, 3]), axis=1)
+    assert_almost_equal(p, [1, 4, 11])
+
+
+def test_topk_sort():
+    x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, -1.0]], np.float32)
+    a = nd.array(x)
+    assert_almost_equal(nd.sort(a, axis=1), np.sort(x, axis=1))
+    assert_almost_equal(nd.argsort(a, axis=1), np.argsort(x, axis=1))
+    v = nd.topk(a, k=2, axis=1, ret_typ="value")
+    assert_almost_equal(v, [[3, 2], [5, 0]])
+    i = nd.topk(a, k=1, axis=1)
+    assert_almost_equal(i, [[0], [1]])
+
+
+def test_astype_context():
+    a = nd.ones((2, 2))
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.as_in_context(mx.cpu(0))
+    assert c.context.device_type == "cpu"
+    d = a.copyto(mx.cpu(0))
+    assert_almost_equal(d, np.ones((2, 2)))
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrs.bin")
+    a, b = nd.ones((2, 2)), nd.arange(0, 4)
+    nd.save(fname, {"a": a, "b": b})
+    loaded = nd.load(fname)
+    assert set(loaded) == {"a", "b"}
+    assert_almost_equal(loaded["a"], np.ones((2, 2)))
+    nd.save(fname, [a, b])
+    lst = nd.load(fname)
+    assert isinstance(lst, list) and len(lst) == 2
+
+
+def test_broadcast():
+    a = nd.array([[1.0], [2.0]])
+    out = nd.broadcast_to(a, shape=(2, 3))
+    assert out.shape == (2, 3)
+    b = nd.array([[1.0, 2.0, 3.0]])
+    assert (a + b).shape == (2, 3)
+    assert_almost_equal(nd.broadcast_axis(a, axis=1, size=3),
+                        np.broadcast_to([[1.0], [2.0]], (2, 3)))
+
+
+def test_wait_sync():
+    a = nd.ones((8, 8))
+    b = (a * 2).wait_to_read()
+    nd.waitall()
+    assert b.asnumpy().sum() == 128
+
+
+def test_random_ops():
+    mx.random.seed(42)
+    u = nd.random.uniform(0, 1, shape=(100,))
+    assert 0 <= u.asnumpy().min() and u.asnumpy().max() <= 1
+    n1 = nd.random.normal(0, 1, shape=(50,))
+    mx.random.seed(42)
+    u2 = nd.random.uniform(0, 1, shape=(100,))
+    assert_almost_equal(u, u2)  # seeding reproduces
+    m = nd.random.multinomial(nd.array([[0.0, 1.0, 0.0]]))
+    assert m.asnumpy()[0] == 1
