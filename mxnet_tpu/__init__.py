@@ -24,3 +24,14 @@ from . import ndarray as nd
 from . import autograd
 from . import random
 from .random import seed
+from . import name
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import lr_scheduler
+from . import metric
+from . import io
+from . import kvstore
+from . import kvstore as kv
+from . import gluon
+from .io import DataBatch, DataIter

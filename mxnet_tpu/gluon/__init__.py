@@ -1,0 +1,11 @@
+"""Gluon: the imperative high-level API
+(reference: python/mxnet/gluon/__init__.py)."""
+from . import parameter
+from .parameter import Parameter, Constant, ParameterDict
+from . import block
+from .block import Block, HybridBlock, SymbolBlock
+from . import nn
+from . import loss
+from . import trainer
+from .trainer import Trainer
+from . import utils

@@ -1,0 +1,448 @@
+"""Gluon Parameter / ParameterDict.
+
+TPU-native rebuild of ``mxnet.gluon.parameter`` (reference:
+python/mxnet/gluon/parameter.py — Parameter :44, deferred init :44-120,
+ParameterDict :509). The reference keeps one NDArray copy per GPU context and
+reduces gradients across them via KVStore; here a Parameter holds ONE
+functional array, and multi-device is expressed by a ``jax.sharding``
+annotation on that single array (data parallelism shards the batch, not the
+parameter), which is the idiomatic GSPMD formulation of the same capability.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from .. import autograd, initializer
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..dtype import resolve_dtype
+from ..ndarray import ndarray as _nd_mod
+from ..ndarray.ndarray import NDArray, _wrap
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant",
+           "ParameterDict", "tensor_types"]
+
+tensor_types = (NDArray,)
+
+
+class DeferredInitializationError(MXNetError):
+    """Error for unfinished deferred initialization
+    (reference: parameter.py:37)."""
+
+
+class Parameter:
+    """A Container holding parameter weight and (optionally) gradient.
+
+    Reference semantics (parameter.py:44-120): shape may contain 0s →
+    deferred init completed at first forward via ``_finish_deferred_init``;
+    ``grad_req`` in {'write', 'add', 'null'}; ``lr_mult``/``wd_mult`` consumed
+    by Trainer/Optimizer.
+    """
+
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data: Optional[NDArray] = None
+        self._grad: Optional[NDArray] = None
+        self._deferred_init = ()
+        self._differentiable = differentiable
+        self._allow_deferred_init = allow_deferred_init
+        self._grad_req = None
+        self.name = name
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.grad_req = grad_req
+        self.init = init
+        if stype not in ("default", "row_sparse", "csr"):
+            raise ValueError(f"invalid stype {stype}")
+        self._stype = stype
+        self._grad_stype = grad_stype
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"
+
+    # -- grad_req ------------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise ValueError(f"grad_req must be write/add/null, got {req}")
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+            if self._data is not None:
+                self._data._grad = None
+                self._data._require_grad = False
+        elif self._data is not None:
+            self._init_grad()
+
+    # -- init machinery ------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=initializer.Uniform(),
+                   force_reinit=False):
+        """Initialize parameter arrays (reference: parameter.py:286)."""
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if init is None:
+            init = default_init if self.init is None else self.init
+        init = initializer.create(init) or default_init
+        if self.shape is None or any(s <= 0 for s in self.shape):
+            if self._allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise ValueError(
+                f"Cannot initialize Parameter '{self.name}' because it has "
+                "invalid shape: {}.".format(self.shape))
+        self._deferred_init = (init, ctx, default_init, None)
+        self._finish_deferred_init()
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init, data = self._deferred_init
+        self._deferred_init = ()
+        if self.shape is None or any(s <= 0 for s in self.shape):
+            raise ValueError(
+                f"Cannot initialize Parameter '{self.name}' because it has "
+                f"invalid shape: {self.shape}.")
+        with autograd.pause():
+            if data is None:
+                data = _nd_mod.array(
+                    np.zeros(self.shape, np.dtype(resolve_dtype(self.dtype))),
+                    ctx=ctx[0])
+                desc = initializer.InitDesc(self.name)
+                init(desc, data)
+            self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx_list):
+        self._ctx_list = list(ctx_list)
+        self._data = data if isinstance(data, NDArray) else _nd_mod.array(data)
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self):
+        import jax.numpy as jnp
+        if self._grad_stype != "default":
+            # row_sparse grads are densified on TPU: XLA reductions over the
+            # batch produce dense grads; sparsity shows up in the optimizer's
+            # lazy_update path instead (reference: parameter.py grad_stype)
+            pass
+        self._data.attach_grad(self._grad_req)
+        self._grad = self._data.grad
+
+    def _check_and_get(self, ctx=None):
+        if self._data is not None:
+            return self._data
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                f"Parameter '{self.name}' has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass. Please pass one batch of data "
+                "through the network before accessing Parameters.")
+        raise RuntimeError(
+            f"Parameter '{self.name}' has not been initialized. Note that you "
+            "should initialize parameters and create Trainer with "
+            "Block.collect_params() instead of Block.params because the later "
+            "does not include Parameters of nested child Blocks")
+
+    # -- shape inference (deferred init) -------------------------------------
+    def _infer_shape(self, known_shape):
+        """Complete 0-dims in self.shape from an observed shape."""
+        if self.shape is None:
+            self.shape = tuple(known_shape)
+            return
+        if len(known_shape) != len(self.shape):
+            raise ValueError(
+                f"Parameter {self.name}: rank mismatch {self.shape} vs "
+                f"{known_shape}")
+        new = []
+        for s, k in zip(self.shape, known_shape):
+            if s > 0 and k > 0 and s != k:
+                raise ValueError(
+                    f"Parameter {self.name}: shape mismatch {self.shape} vs "
+                    f"{known_shape}")
+            new.append(s if s > 0 else k)
+        self.shape = tuple(new)
+
+    def shape_is_known(self):
+        return self.shape is not None and all(s > 0 for s in self.shape)
+
+    # -- data access ---------------------------------------------------------
+    def data(self, ctx=None) -> NDArray:
+        """The parameter array (reference: parameter.py:389)."""
+        return self._check_and_get(ctx)
+
+    def list_data(self):
+        """All per-context copies — exactly one here (sharding replaces
+        replication; reference: parameter.py:402)."""
+        return [self._check_and_get()]
+
+    def grad(self, ctx=None) -> NDArray:
+        d = self._check_and_get(ctx)
+        if d.grad is None:
+            raise RuntimeError(
+                f"Cannot get gradient array for Parameter '{self.name}' "
+                "because grad_req='null'")
+        return d.grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return self._deferred_init[1]
+            raise RuntimeError(f"Parameter '{self.name}' has not been "
+                               "initialized")
+        return getattr(self, "_ctx_list", [self._data.context])
+
+    def zero_grad(self):
+        """Set gradient to 0 (reference: parameter.py:447)."""
+        if self._grad is None:
+            return
+        import jax.numpy as jnp
+        self._grad._data = jnp.zeros_like(self._grad._data)
+
+    def set_data(self, data):
+        """Set this parameter's value everywhere (reference: parameter.py:419)."""
+        if isinstance(data, NDArray):
+            src = data
+        else:
+            src = _nd_mod.array(data)
+        if self._data is None:
+            if self._deferred_init:
+                self._infer_shape(src.shape)
+                init, ctx, default_init, _ = self._deferred_init
+                self._deferred_init = (init, ctx, default_init, src)
+                self._finish_deferred_init()
+                return
+            # loading into a never-initialized parameter: initialize from the
+            # data directly (reference: parameter.py _load_init)
+            self._infer_shape(src.shape)
+            self._init_impl(src.copy(), [current_context()])
+            return
+        self._infer_shape(src.shape)
+        self._data._data = src._data.astype(self._data.dtype) \
+            if src.dtype != self._data.dtype else src._data
+
+    def reset_ctx(self, ctx):
+        """Re-assign to new devices (reference: parameter.py:431)."""
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is not None:
+            self._data = self._data.as_in_context(ctx[0])
+            self._ctx_list = list(ctx)
+            if self._grad_req != "null":
+                self._init_grad()
+        elif self._deferred_init:
+            init, _, default_init, data = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+
+    def cast(self, dtype):
+        """Cast data and gradient (reference: parameter.py:469)."""
+        self.dtype = dtype
+        if self._data is None:
+            return
+        with autograd.pause():
+            self._data = self._data.astype(dtype)
+            if self._grad_req != "null":
+                self._init_grad()
+
+    def var(self):
+        """The symbolic variable for this parameter (reference:
+        parameter.py:497)."""
+        if self._var is None:
+            from .. import symbol as _sym
+            self._var = _sym.var(self.name, shape=self.shape, dtype=self.dtype,
+                                 lr_mult=self.lr_mult, wd_mult=self.wd_mult,
+                                 init=self.init)
+        return self._var
+
+
+class Constant(Parameter):
+    """A constant (non-trained) parameter (reference: parameter.py:600)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = _nd_mod.array(value)
+        self.value = value
+
+        class _Init(initializer.Initializer):
+            def _init_weight(self2, _, arr):
+                arr._data = value._data
+
+            _init_default = _init_weight
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, init=_Init(), differentiable=False)
+
+
+class ParameterDict:
+    """A dictionary managing a set of Parameters (reference:
+    parameter.py:509+). Supports prefix sharing for nested Blocks."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __repr__(self):
+        name = self._prefix + " " if self._prefix else ""
+        body = "\n".join(f"  {v!r}" for v in self.values())
+        return f"{name}(\n{body}\n)"
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Retrieve or create a Parameter named ``prefix+name``
+        (reference: parameter.py:557)."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and existing is not None:
+                        v = tuple(v) if not isinstance(v, int) else (v,)
+                        # merge partial shapes; conflicting known dims is an
+                        # error (reference: parameter.py Parameter shape merge)
+                        if len(v) == len(existing):
+                            for a, b in zip(existing, v):
+                                if a > 0 and b > 0 and a != b:
+                                    raise AssertionError(
+                                        f"Parameter '{name}' already exists "
+                                        f"with shape {existing}, incompatible "
+                                        f"with requested {v}")
+                            param.shape = tuple(
+                                a if a > 0 else b
+                                for a, b in zip(existing, v))
+                            continue
+                    if v is not None and v != existing and k in ("dtype",):
+                        param.cast(v)
+                elif v is not None:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError(f"No constant named '{name}'")
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        """Copy all Parameters in ``other`` (reference: parameter.py:627)."""
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError(
+                    f"Cannot update self with other because they have different "
+                    f"Parameters with the same name '{k}'")
+            self._params[k] = v
+
+    def initialize(self, init=initializer.Uniform(), ctx=None, verbose=False,
+                   force_reinit=False):
+        for v in self.values():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    # -- (de)serialization ---------------------------------------------------
+    def save(self, filename, strip_prefix=""):
+        """Save to .params file (reference: parameter.py:713; format is the
+        ndarray map save — see mxnet_tpu.ndarray save)."""
+        arg_dict = {}
+        for param in self.values():
+            block = param.list_data()
+            weight = sum(b.copyto(cpu()) for b in block[1:]) if len(block) > 1 \
+                else block[0]
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    f"Prefix '{strip_prefix}' is to be stripped before saving, "
+                    f"but Parameter's name '{param.name}' does not start with "
+                    f"'{strip_prefix}'")
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        from ..ndarray import save as nd_save
+        nd_save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        """Load from .params file (reference: parameter.py:740)."""
+        from ..ndarray import load as nd_load
+        arg_dict = nd_load(filename)
+        if restore_prefix:
+            arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
+        # strip arg:/aux: markers from Module-style files
+        arg_dict = {k[4:] if k.startswith(("arg:", "aux:")) else k: v
+                    for k, v in arg_dict.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in arg_dict:
+                    raise IOError(
+                        f"Parameter '{name}' is missing in file '{filename}'")
+        for name, v in arg_dict.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise IOError(
+                        f"Parameter '{name}' loaded from file '{filename}' is "
+                        "not present in ParameterDict")
+                continue
+            self._params[name].set_data(v)

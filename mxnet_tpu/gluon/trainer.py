@@ -1,0 +1,165 @@
+"""Gluon Trainer.
+
+TPU-native rebuild of ``mxnet.gluon.trainer`` (reference:
+python/mxnet/gluon/trainer.py — step :156-200, kvstore wiring :94-154,
+save/load_states :202-235).
+
+Architectural mapping: the reference pushes gradients to a KVStore
+(priority=-i for comm/compute overlap) and pulls averaged weights back. Here
+single-process training applies the optimizer directly; data-parallel
+gradient averaging happens inside the pjit'd step via ``psum`` (see
+``mxnet_tpu.kvstore`` / ``mxnet_tpu.parallel``), where XLA overlaps the
+collectives with backward compute automatically — the engine-priority trick
+falls out of the dataflow.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from ..ndarray.ndarray import NDArray
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    """Applies an Optimizer to a set of Parameters (reference:
+    trainer.py:30)."""
+
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                f"got {type(params)}.")
+        self._params = []
+        for param in params:
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    f"got list of {type(param)}.")
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._update_on_kvstore = update_on_kvstore
+        self._kv_initialized = False
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an Optimizer " \
+                "instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        """Create the kvstore facade lazily (reference: trainer.py:94)."""
+        from .. import kvstore as kvs
+        if self._kvstore_type is not None and \
+                not isinstance(self._kvstore_type, str):
+            self._kvstore = self._kvstore_type
+        elif self._kvstore_type:
+            self._kvstore = kvs.create(self._kvstore_type)
+        if self._kvstore is not None and self._update_on_kvstore is not False \
+                and self._kvstore.is_distributed:
+            self._kvstore.set_optimizer(self._optimizer)
+            self._update_on_kvstore = True
+        else:
+            self._update_on_kvstore = False
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr if self._optimizer.lr_scheduler is None else \
+            self._optimizer.lr_scheduler(self._optimizer.num_update)
+
+    def set_learning_rate(self, lr):
+        """(reference: trainer.py:150)"""
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Apply one optimization step with gradients scaled by 1/batch_size
+        (reference: trainer.py:156)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._all_reduce_grads()
+        self._update(ignore_stale_grad)
+
+    def _all_reduce_grads(self):
+        """Cross-device gradient reduction. Single-controller TPU training
+        shards the batch inside the jitted step, where psum already averaged
+        the grads; multi-process mode reduces here via the kvstore facade."""
+        if self._kvstore is not None and self._kvstore.is_distributed \
+                and not self._update_on_kvstore:
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    self._kvstore.push(i, param.list_grad(), priority=-i)
+                    self._kvstore.pull(i, param.list_grad(), priority=-i)
+
+    def _update(self, ignore_stale_grad=False):
+        updater = self._updaters[0]
+        if not hasattr(self, "_last_grad_seq"):
+            self._last_grad_seq = {}
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if not ignore_stale_grad and param.grad_req == "write":
+                # backward stamps each written leaf grad with the global
+                # backward sequence number; a step that sees the same stamp
+                # as last step means backward never touched this parameter
+                # (reference semantics: trainer.py:176 _version check)
+                data = param._check_and_get()
+                seq = getattr(data, "_grad_written_seq", None)
+                if seq is None or seq == self._last_grad_seq.get(i):
+                    raise UserWarning(
+                        f"Gradient of Parameter `{param.name}` has not been "
+                        "updated by backward since last `step`. This could "
+                        "mean a bug in your model that made it only use a "
+                        "subset of the Parameters for the last forward pass. "
+                        "Call step with ignore_stale_grad=True to suppress "
+                        "this warning and skip updating of Parameters with "
+                        "stale gradient")
+                self._last_grad_seq[i] = seq
+            if self._update_on_kvstore:
+                continue  # kvstore applied the update in push
+            updater(i, param.grad(), param.data())
+
+    def allreduce_grads(self):
+        """Explicit grad reduction without update (reference: trainer.py)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._all_reduce_grads()
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Apply optimizer update only — for use after allreduce_grads
+        (reference: trainer.py update)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def save_states(self, fname):
+        """Save optimizer/updater states (reference: trainer.py:202)."""
+        assert self._optimizer is not None
+        with open(fname, "wb") as fout:
+            fout.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        """(reference: trainer.py:217)"""
+        with open(fname, "rb") as f:
+            states = f.read()
+        self._updaters[0].set_states(states)
+        self._optimizer = self._updaters[0].optimizer
+        self._optimizer.param_dict = {
+            i: param for i, param in enumerate(self._params)}

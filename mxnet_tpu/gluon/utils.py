@@ -1,0 +1,101 @@
+"""Gluon utilities (reference: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..context import Context, cpu
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split an NDArray along ``batch_axis`` into ``num_slice`` pieces
+    (reference: utils.py:38-77)."""
+    size = data.shape[batch_axis]
+    if size < num_slice:
+        raise ValueError(
+            f"Too many slices for data with shape {data.shape}. Arguments are "
+            f"num_slice={num_slice} and batch_axis={batch_axis}.")
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}. Use a batch size "
+            f"that's multiple of {num_slice} or set even_split=False to allow "
+            "uneven partitioning of data.")
+    step = size // num_slice
+    if not even_split:
+        slices = [
+            data.slice_axis(batch_axis, i * step,
+                            (i + 1) * step if i < num_slice - 1 else size)
+            for i in range(num_slice)]
+    else:
+        slices = [data.slice_axis(batch_axis, i * step, (i + 1) * step)
+                  for i in range(num_slice)]
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split data and load each slice to one context
+    (reference: utils.py:80-110).
+
+    On TPU the idiomatic form is a single sharded array over the mesh; this
+    per-context form is kept for reference-API compatibility and for the
+    Module/executor-group emulation."""
+    if not isinstance(data, nd.NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm):
+    """Rescale so the sum of their 2-norms is at most ``max_norm``
+    (reference: utils.py:113-133)."""
+    import jax.numpy as jnp
+    assert len(arrays) > 0
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(a._data)) for a in arrays))
+    total_norm = float(total)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._data = a._data * scale
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    """(reference: utils.py:136)"""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None):
+    """Download a file (reference: utils.py:157). This environment has no
+    network egress; only file:// and existing local paths resolve."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and \
+            (not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    if url.startswith("file://"):
+        import shutil
+        shutil.copyfile(url[7:], fname)
+        return fname
+    raise RuntimeError(
+        f"cannot download {url}: no network egress in this environment; "
+        "place the file at the target path manually")

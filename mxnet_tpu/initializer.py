@@ -1,0 +1,375 @@
+"""Weight initializers.
+
+TPU-native rebuild of ``mxnet.initializer`` (reference:
+python/mxnet/initializer.py — registry :95, Xavier :545, MSRAPrelu :611,
+Orthogonal :508, Bilinear :635, LSTMBias :653, Load/Mixed :287-334). The
+reference dispatches on *name patterns* ("weight"/"bias"/"gamma"/...) and
+fills pre-allocated NDArrays in place; here initializers are the same
+name-dispatched callables, writing into the NDArray's functional buffer.
+"""
+from __future__ import annotations
+
+import json
+import re
+import warnings
+
+import numpy as np
+
+__all__ = ["InitDesc", "Initializer", "register", "create", "Zero", "One",
+           "Constant", "Uniform", "Normal", "Orthogonal", "Xavier",
+           "MSRAPrelu", "Bilinear", "LSTMBias", "Load", "Mixed"]
+
+_INIT_REGISTRY = {}
+
+
+def register(klass):
+    """Register an initializer under its lowercase class name
+    (reference: initializer.py:95 ``Initializer.register``)."""
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def _alias(name):
+    def wrapper(klass):
+        _INIT_REGISTRY[name] = klass
+        return klass
+    return wrapper
+
+
+def create(init, **kwargs):
+    """Create an initializer from a str name / instance / None."""
+    if init is None:
+        return None
+    if isinstance(init, Initializer):
+        return init
+    if callable(init) and not isinstance(init, type):
+        return init
+    if isinstance(init, str):
+        name = init.lower()
+        if name not in _INIT_REGISTRY:
+            raise ValueError(f"Unknown initializer {init!r}; known: "
+                             f"{sorted(_INIT_REGISTRY)}")
+        return _INIT_REGISTRY[name](**kwargs)
+    if isinstance(init, type) and issubclass(init, Initializer):
+        return init(**kwargs)
+    raise TypeError(f"Cannot create initializer from {init!r}")
+
+
+class InitDesc(str):
+    """Descriptor for the parameter being initialized: a string (name) with
+    ``attrs`` and ``global_init`` (reference: initializer.py:48-62)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    """Base initializer (reference: initializer.py:65-270).
+
+    ``init(desc, arr)`` dispatches on the name: ops ending in weight/bias/
+    gamma/beta/mean/var get the corresponding _init_* method; an ``__init__``
+    attr on the desc overrides with a named initializer.
+    """
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        self._print_func = print_func or (lambda x: None)
+        return self
+
+    def dumps(self):
+        """Serialize as JSON [name, kwargs] (reference: initializer.py:152)."""
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        if desc.global_init is None:
+            desc.global_init = self
+        init = desc.attrs.get("__init__", "")
+        if init:
+            create(init)._init_weight(desc, arr)
+        elif desc.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif desc.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif desc.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif desc.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif desc.endswith("running_mean") or desc.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif desc.endswith("running_var") or desc.endswith("moving_var"):
+            self._init_one(desc, arr)
+        elif desc.endswith("moving_inv_var") or desc.endswith("moving_avg"):
+            self._init_zero(desc, arr)
+        elif desc.endswith("min") or desc.endswith("max"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # -- fill helpers --------------------------------------------------------
+    @staticmethod
+    def _set(arr, value):
+        import jax.numpy as jnp
+        value = np.asarray(value)
+        if hasattr(arr, "_data"):  # NDArray
+            arr._data = jnp.asarray(value, arr.dtype)
+        else:
+            arr[:] = value
+
+    @staticmethod
+    def _shape(arr):
+        return tuple(arr.shape)
+
+    @staticmethod
+    def _rng():
+        from . import random as _rnd
+        return _rnd.numpy_rng()
+
+    def _init_zero(self, name, arr):
+        self._set(arr, np.zeros(self._shape(arr)))
+
+    def _init_one(self, name, arr):
+        self._set(arr, np.ones(self._shape(arr)))
+
+    def _init_bias(self, name, arr):
+        self._init_zero(name, arr)
+
+    def _init_gamma(self, name, arr):
+        self._init_one(name, arr)
+
+    def _init_beta(self, name, arr):
+        self._init_zero(name, arr)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError("Must override _init_weight")
+
+    def _init_default(self, name, arr):
+        raise ValueError(
+            f"Unknown initialization pattern for {name}. Default init supports "
+            "names ending with weight/bias/gamma/beta; set the parameter's "
+            "init= explicitly for others.")
+
+
+@_alias("zeros")
+@register
+class Zero(Initializer):
+    """(reference: initializer.py:347 ``@register class Zero``)"""
+
+    def _init_weight(self, name, arr):
+        self._init_zero(name, arr)
+
+    _init_default = _init_weight
+
+
+@_alias("ones")
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_one(name, arr)
+
+    _init_default = _init_weight
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        v = self.value
+        if hasattr(v, "asnumpy"):
+            v = v.asnumpy()
+        self._set(arr, np.broadcast_to(np.asarray(v), self._shape(arr)))
+
+    _init_default = _init_weight
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) (reference: initializer.py:386)."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        self._set(arr, self._rng().uniform(-self.scale, self.scale,
+                                           self._shape(arr)))
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma^2) (reference: initializer.py:411)."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        self._set(arr, self._rng().normal(0, self.sigma, self._shape(arr)))
+
+
+@register
+class Orthogonal(Initializer):
+    """Orthogonal matrix init (reference: initializer.py:508; Saxe et al.)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        shape = self._shape(arr)
+        nout = shape[0]
+        nin = int(np.prod(shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = self._rng().uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = self._rng().normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        res = u if u.shape == (nout, nin) else v
+        self._set(arr, self.scale * res.reshape(shape))
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot (reference: initializer.py:545-608).
+
+    factor_type in {avg, in, out}; rnd_type in {uniform, gaussian}.
+    """
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = self._shape(arr)
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError(
+                f"Xavier initializer cannot be applied to vector {name}: "
+                "it requires at least 2D shape")
+        if len(shape) > 2:
+            hw_scale = np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = 1.0
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            self._set(arr, self._rng().uniform(-scale, scale, shape))
+        elif self.rnd_type == "gaussian":
+            self._set(arr, self._rng().normal(0, scale, shape))
+        else:
+            raise ValueError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    """He init adjusted for PReLU (reference: initializer.py:611)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (reference: initializer.py:635)."""
+
+    def _init_weight(self, name, arr):
+        shape = self._shape(arr)
+        weight = np.zeros(int(np.prod(shape)), dtype=np.float32)
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(np.prod(shape)):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._set(arr, weight.reshape(shape))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (reference: initializer.py:653-675)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        shape = self._shape(arr)
+        b = np.zeros(shape)
+        num_hidden = shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        self._set(arr, b)
+
+    _init_default = _init_weight
+    _init_bias = _init_weight
+
+
+@register
+class Load:
+    """Init from a dict of arrays, falling back to ``default_init``
+    (reference: initializer.py:287)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {k[4:] if k.startswith(("arg:", "aux:")) else k: v
+                      for k, v in param.items()}
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            p = self.param[name]
+            src = p.asnumpy() if hasattr(p, "asnumpy") else np.asarray(p)
+            if tuple(src.shape) != tuple(arr.shape):
+                raise ValueError(f"Parameter {name} cannot be initialized from "
+                                 f"loading. Shape mismatch, target "
+                                 f"{tuple(arr.shape)} vs loaded {src.shape}")
+            Initializer._set(arr, src)
+        else:
+            if self.default_init is None:
+                raise ValueError(f"Cannot Initialize parameter {name}. Not "
+                                 "found in loaded param and no default "
+                                 "initializer is provided.")
+            self.default_init(name, arr)
+
+
+@register
+class Mixed:
+    """Pattern-dispatched initializer list (reference: initializer.py:334)."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise ValueError("patterns and initializers must have same length")
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(str(name)):
+                init(name, arr)
+                return
+        raise ValueError(
+            f"Parameter name {name} did not match any pattern. Consider adding "
+            "a \".*\" pattern at the end with default Initializer.")

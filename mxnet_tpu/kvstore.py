@@ -1,0 +1,182 @@
+"""KVStore facade.
+
+TPU-native rebuild of ``mxnet.kvstore`` (reference: python/mxnet/kvstore.py;
+native src/kvstore/ — KVStoreLocal kvstore_local.h:52, CommDevice comm.h:428,
+KVStoreNCCL kvstore_nccl.h:62, KVStoreDist kvstore_dist.h:44).
+
+Architectural mapping: the reference's four backends (local CPU-reduce,
+device P2P-reduce, NCCL collectives, ps-lite parameter server) all collapse
+on TPU into XLA collectives over the ICI mesh. This module keeps the
+KVStore *API* (init/push/pull/row_sparse_pull/set_optimizer) because Module,
+Trainer, and user scripts program against it:
+
+- 'local' / 'device' / 'nccl'  → single-process store; "reduction" over the
+  per-device gradient copies is a sum (with one logical array per parameter
+  the copies are sharded views, and the actual cross-chip reduction is a
+  ``psum`` XLA inserts inside the pjit'd step — see mxnet_tpu.parallel).
+- 'dist_sync' / 'dist_device_sync' / 'dist_async' → multi-process data
+  parallelism over jax.distributed; push+pull becomes an all-reduce across
+  processes (see mxnet_tpu.parallel.dist). The parameter-server *role*
+  disappears; "update_on_kvstore" maps to running the optimizer on the
+  reduced gradient once per key, which is semantically the server-side
+  optimizer of kvstore_dist_server.h:187.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+def _as_key_list(key, value):
+    """Normalize (key, value) to parallel lists (reference:
+    python/mxnet/kvstore.py _ctype_key_value)."""
+    if isinstance(key, (list, tuple)):
+        keys, values = [], []
+        for k, v in zip(key, value):
+            keys.append(k)
+            values.append(v)
+        return keys, values
+    return [key], [value]
+
+
+class KVStore:
+    """Key-value store for parameter synchronization (reference:
+    python/mxnet/kvstore.py:55)."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._data: Dict = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+
+    # -- basic ----------------------------------------------------------------
+    def init(self, key, value):
+        """Initialize key(s) once (reference: kvstore.py:93)."""
+        keys, values = _as_key_list(key, value)
+        for k, v in zip(keys, values):
+            if k in self._data:
+                continue
+            v0 = v[0] if isinstance(v, (list, tuple)) else v
+            self._data[k] = v0.copy()
+
+    def push(self, key, value, priority=0):
+        """Push (accumulate) values (reference: kvstore.py:130).
+
+        Per-key semantics match KVStoreLocal::Push: multiple device copies
+        are summed, then either stored (for later pull) or fed to the
+        updater if one is set (update_on_kvstore)."""
+        keys, values = _as_key_list(key, value)
+        for k, v in zip(keys, values):
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            agg = vals[0]
+            for extra in vals[1:]:
+                agg = agg + extra
+            if self._updater is not None:
+                if k not in self._data:
+                    raise ValueError(f"key {k} not initialized")
+                self._updater(_key_int(k), agg, self._data[k])
+            else:
+                self._merged = getattr(self, "_merged", {})
+                self._merged[k] = agg
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Pull current value into out (reference: kvstore.py:164)."""
+        keys, outs = _as_key_list(key, out)
+        for k, o in zip(keys, outs):
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            merged = getattr(self, "_merged", {})
+            if self._updater is None and k in merged:
+                src = merged[k]
+            else:
+                src = self._data[k]
+            for t in targets:
+                t._data = src._data
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in row_ids (reference: kvstore.py:195-209;
+        sharded-embedding analog)."""
+        assert row_ids is not None, "row_ids is required for row_sparse_pull"
+        keys, outs = _as_key_list(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        for k, o, r in zip(keys, outs, rids * (len(keys) // max(len(rids), 1) or 1)):
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            src = self._data[k]
+            rows = src.take(r, axis=0) if hasattr(src, "take") else src
+            for t in targets:
+                t._data = rows._data
+
+    # -- optimizer ------------------------------------------------------------
+    def set_updater(self, updater):
+        """(reference: kvstore.py:360)"""
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        """Run this optimizer inside the store — "update_on_kvstore"
+        (reference: kvstore.py:323; dist server analog
+        kvstore_dist_server.h:187)."""
+        from . import optimizer as opt
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        """(reference: kvstore.py set_gradient_compression;
+        native gradient_compression.h:37-134). Applied in the dist path."""
+        self._compression_params = dict(compression_params)
+
+    # -- cluster topology -----------------------------------------------------
+    @property
+    def rank(self):
+        import jax
+        return jax.process_index() if self.is_distributed else 0
+
+    @property
+    def num_workers(self):
+        import jax
+        return jax.process_count() if self.is_distributed else 1
+
+    @property
+    def is_distributed(self):
+        return "dist" in self.type
+
+    def barrier(self):
+        if self.is_distributed:
+            from .parallel import dist as _dist
+            _dist.barrier()
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def _key_int(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+def create(name="local"):
+    """Create a KVStore (reference: python/mxnet/kvstore.py:628; native
+    factory src/kvstore/kvstore.cc:40-75)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    valid = ("local", "device", "nccl", "local_allreduce_cpu",
+             "local_allreduce_device", "dist_sync", "dist_device_sync",
+             "dist_async", "dist")
+    if name not in valid:
+        raise ValueError(f"unknown kvstore type {name!r}; valid: {valid}")
+    if "dist" in name:
+        from .parallel.dist import DistKVStore
+        return DistKVStore(name)
+    return KVStore(name)

@@ -147,11 +147,15 @@ def pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
     else:
         pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
 
+    # NOTE: init values must be python scalars — a traced/array init prevents
+    # JAX from selecting the differentiable reduce_window_{max,sum} primitives
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return jax.lax.reduce_window(data, jnp.asarray(init, data.dtype),
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else int(jnp.iinfo(data.dtype).min)
+        return jax.lax.reduce_window(data, init,
                                      jax.lax.max, window, strides, pads)
-    summed = jax.lax.reduce_window(data, jnp.asarray(0, data.dtype),
+    zero = 0.0 if jnp.issubdtype(data.dtype, jnp.floating) else 0
+    summed = jax.lax.reduce_window(data, zero,
                                    jax.lax.add, window, strides, pads)
     if pool_type == "sum":
         return summed

@@ -1,0 +1,742 @@
+"""Optimizers.
+
+TPU-native rebuild of ``mxnet.optimizer`` (reference:
+python/mxnet/optimizer.py:34-1506). Same registry/updater architecture: an
+``Optimizer`` computes functional state updates per (index, weight, grad);
+``Updater`` owns the per-index state dict and is the object handed to
+KVStore/Trainer. All update math lives in ``mxnet_tpu.ops.optimizer_ops`` —
+single fused XLA kernels per update, replacing the reference's hand-written
+CUDA kernels (src/operator/optimizer_op.cc).
+"""
+from __future__ import annotations
+
+import logging
+import math
+import pickle
+import warnings
+
+import numpy as np
+
+from .ndarray import ndarray as _nd_mod
+from .ndarray.ndarray import NDArray, _wrap
+from .ops import get_op
+
+__all__ = ["Optimizer", "SGD", "Signum", "FTML", "DCASGD", "NAG", "SGLD",
+           "Adam", "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Adamax",
+           "Nadam", "LBSGD", "Test", "Updater", "get_updater", "create",
+           "register"]
+
+
+def _asnd(x):
+    return x if isinstance(x, NDArray) else _wrap(x)
+
+
+def _op(name, *arrays, **attrs):
+    """Run an optimizer update op directly on raw buffers (no autograd)."""
+    fn = get_op(name).fn
+    raw = [a._data if isinstance(a, NDArray) else a for a in arrays]
+    return fn(*raw, **attrs)
+
+
+class _MPState:
+    """Multi-precision state: fp32 master weight + the optimizer's own state
+    (reference analog: mp_sgd_update's weight32, src/operator/optimizer_op.cc)."""
+
+    __slots__ = ("master", "inner")
+
+    def __init__(self, master, inner):
+        self.master = master
+        self.inner = inner
+
+
+class Optimizer:
+    """Base optimizer (reference: optimizer.py:34-432)."""
+
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        """Register an optimizer under its lowercase class name
+        (reference: optimizer.py:57)."""
+        name = klass.__name__.lower()
+        if name in Optimizer.opt_registry:
+            warnings.warn(f"WARNING: New optimizer {klass.__name__} is "
+                          f"overriding existing optimizer "
+                          f"{Optimizer.opt_registry[name].__name__}")
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        """(reference: optimizer.py:81)"""
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError(f"Cannot find optimizer {name}")
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), \
+            "param_idx2name should be a dict of param indexes to names."
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None \
+            else ()
+        self.param_dict = param_dict if param_dict else {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    def create_state(self, index, weight):
+        """Create per-weight state (reference: optimizer.py:239)."""
+        return None
+
+    def _is_low_precision(self, weight):
+        return weight.dtype == np.float16 or str(weight.dtype) == "bfloat16"
+
+    def create_state_multi_precision(self, index, weight):
+        """fp32 master weight + normal state when multi_precision and weight
+        is fp16/bf16 (reference: optimizer.py:247)."""
+        if self.multi_precision and self._is_low_precision(weight):
+            weight_master_copy = weight.astype("float32")
+            return _MPState(weight_master_copy,
+                            self.create_state(index, weight_master_copy))
+        if weight.dtype == np.float16 and not self.multi_precision:
+            warnings.warn("Accumulating with float16 in optimizer can lead to "
+                          "poor accuracy or slow convergence. Consider using "
+                          "multi_precision=True option of the optimizer")
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        """Update weight given gradient — override (reference:
+        optimizer.py:269)."""
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        """(reference: optimizer.py:285)"""
+        if isinstance(state, _MPState):
+            grad32 = grad.astype("float32")
+            self.update(index, state.master, grad32, state.inner)
+            weight._data = state.master._data.astype(weight._data.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined. Note that set_learning_rate can mutate "
+                              "the value of the learning rate of the optimizer "
+                              "only when the LRScheduler of the optimizer is "
+                              "undefined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        """(reference: optimizer.py:330)"""
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        """Weight decay skipped for bias/gamma/beta by default
+        (reference: optimizer.py:360)."""
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        """(reference: optimizer.py:411)"""
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        """(reference: optimizer.py:432)"""
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def __getstate__(self):
+        ret = self.__dict__.copy()
+        ret["lr_scheduler"] = self.lr_scheduler
+        return ret
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+def _zeros_like(weight, dtype=None):
+    import jax.numpy as jnp
+    return _wrap(jnp.zeros(weight.shape,
+                           dtype or weight._data.dtype), weight._ctx)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and optional multi-precision
+    (reference: optimizer.py:433-530). ``lazy_update`` applies only to
+    row_sparse grads (sparse layer handles it)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      clip_gradient=self.clip_gradient or -1.0)
+        if state is not None:
+            w, m = _op("sgd_mom_update", weight, grad, state,
+                       momentum=self.momentum, **kwargs)
+            weight._data = w
+            state._data = m
+        else:
+            weight._data = _op("sgd_update", weight, grad, **kwargs)
+
+    update_multi_precision = Optimizer.update_multi_precision
+
+
+@register
+class Signum(Optimizer):
+    """Sign-based SGD (reference: optimizer.py:531-589)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      clip_gradient=self.clip_gradient or -1.0)
+        if state is not None:
+            w, m = _op("signum_update", weight, grad, state,
+                       momentum=self.momentum, wd_lh=self.wd_lh, **kwargs)
+            weight._data = w
+            state._data = m
+        else:
+            weight._data = _op("signsgd_update", weight, grad, **kwargs)
+
+
+@register
+class FTML(Optimizer):
+    """Follow the Moving Leader (reference: optimizer.py:590-640)."""
+
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        w, dn, vn, zn = _op("ftml_update", weight, grad, d, v, z, lr=lr,
+                            beta1=self.beta1, beta2=self.beta2,
+                            epsilon=self.epsilon, wd=wd, t=t,
+                            rescale_grad=self.rescale_grad,
+                            clip_grad=self.clip_gradient or -1.0)
+        weight._data, d._data, v._data, z._data = w, dn, vn, zn
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py:641-698)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (_zeros_like(weight), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        mom, previous_weight = state
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        comp = g + self.lamda * g * g * (weight._data - previous_weight._data)
+        step = -lr * (comp + wd * weight._data)
+        if mom is not None:
+            mom._data = mom._data * self.momentum + step
+            step_total = mom._data
+        else:
+            assert self.momentum == 0.0
+            step_total = step
+        previous_weight._data = weight._data
+        weight._data = weight._data + step_total
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference: optimizer.py:699-746)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      clip_gradient=self.clip_gradient or -1.0)
+        if state is not None:
+            w, m = _op("nag_mom_update", weight, grad, state,
+                       momentum=self.momentum, **kwargs)
+            weight._data = w
+            state._data = m
+        else:
+            weight._data = _op("sgd_update", weight, grad, **kwargs)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (reference: optimizer.py:747)."""
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        from . import random as _random
+        import jax
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        noise = jax.random.normal(_random.next_key(), weight.shape,
+                                  weight._data.dtype) * math.sqrt(lr)
+        weight._data = weight._data - lr / 2 * (g + wd * weight._data) + noise
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference: optimizer.py:778-839)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        w, m, v = _op("adam_update", weight, grad, mean, var, lr=lr,
+                      beta1=self.beta1, beta2=self.beta2,
+                      epsilon=self.epsilon, wd=wd,
+                      rescale_grad=self.rescale_grad,
+                      clip_gradient=self.clip_gradient or -1.0)
+        weight._data, mean._data, var._data = w, m, v
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference: optimizer.py:840-885)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        state._data = state._data + g * g
+        weight._data = weight._data - lr * \
+            (g / jnp.sqrt(state._data + self.float_stable_eps) +
+             wd * weight._data)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, centered or not (reference: optimizer.py:886-961)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (_zeros_like(weight), _zeros_like(weight),
+                    _zeros_like(weight))
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = dict(lr=lr, gamma1=self.gamma1, epsilon=self.epsilon, wd=wd,
+                      rescale_grad=self.rescale_grad,
+                      clip_gradient=self.clip_gradient or -1.0,
+                      clip_weights=self.clip_weights or -1.0)
+        if not self.centered:
+            n = state
+            w, nn = _op("rmsprop_update", weight, grad, n, **kwargs)
+            weight._data, n._data = w, nn
+        else:
+            n, g, delta = state
+            w, nn, gn, dn = _op("rmspropalex_update", weight, grad, n, g,
+                                delta, gamma2=self.gamma2, **kwargs)
+            weight._data, n._data, g._data, delta._data = w, nn, gn, dn
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference: optimizer.py:962-1014)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g._data = self.rho * acc_g._data + (1.0 - self.rho) * g * g
+        current_delta = jnp.sqrt(acc_delta._data + self.epsilon) / \
+            jnp.sqrt(acc_g._data + self.epsilon) * g
+        acc_delta._data = self.rho * acc_delta._data + \
+            (1.0 - self.rho) * current_delta * current_delta
+        weight._data = weight._data - current_delta - wd * weight._data
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL (reference: optimizer.py:1015-1081)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        z, n = state
+        w, zn, nn = _op("ftrl_update", weight, grad, z, n, lr=lr,
+                        lamda1=self.lamda1, beta=self.beta, wd=wd,
+                        rescale_grad=self.rescale_grad,
+                        clip_gradient=self.clip_gradient or -1.0)
+        weight._data, z._data, n._data = w, zn, nn
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax (reference: optimizer.py:1082-1137)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1.0 - self.beta1 ** t)
+        g = grad._data * self.rescale_grad + wd * weight._data
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        m_t, u_t = state
+        m_t._data = self.beta1 * m_t._data + (1.0 - self.beta1) * g
+        u_t._data = jnp.maximum(self.beta2 * u_t._data, jnp.abs(g))
+        weight._data = weight._data - lr * m_t._data / (u_t._data + 1e-8)
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (reference: optimizer.py:1138-1204)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        g = grad._data * self.rescale_grad + wd * weight._data
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 **
+                                     ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        m_t._data = self.beta1 * m_t._data + (1.0 - self.beta1) * g
+        v_t._data = self.beta2 * v_t._data + (1.0 - self.beta2) * g * g
+        grad_prime = g / (1.0 - self.m_schedule)
+        m_t_prime = m_t._data / (1.0 - m_schedule_next)
+        v_t_prime = v_t._data / (1.0 - self.beta2 ** t)
+        m_t_bar = (1.0 - momentum_t) * grad_prime + momentum_t_1 * m_t_prime
+        weight._data = weight._data - lr * m_t_bar / \
+            (jnp.sqrt(v_t_prime) + self.epsilon)
+
+
+@register
+class LBSGD(Optimizer):
+    """Large-batch SGD with LARS layer-wise adaptive rate + warmup
+    (reference: optimizer.py:648 LBSGD). Needed for the large-per-chip-batch
+    regime that maximizes TPU MFU."""
+
+    def __init__(self, momentum=0.0, multi_precision=False, warmup_strategy
+                 ="linear", warmup_epochs=5, batch_scale=1, updates_per_epoch=32,
+                 begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(multi_precision=multi_precision, **kwargs)
+        self.momentum = momentum
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+        self.init_updates = begin_epoch * updates_per_epoch
+        self.num_epochs = num_epochs
+        self.lbmult = 1.0
+        self.cumgrads = {}
+        self.adaptive = warmup_strategy == "lars"
+        self.admult = 1.0
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight) if self.momentum != 0.0 else None
+
+    def _get_lbmult(self, nup):
+        """Warmup multiplier (reference: optimizer.py LBSGD._get_lbmult)."""
+        nwup = self.warmup_epochs * self.updates_per_epoch
+        strategy = self.warmup_strategy
+        maxmult = float(self.batch_scale)
+        if nup >= nwup:
+            mult = maxmult
+        elif nwup <= 1:
+            mult = 1.0
+        else:
+            if strategy == "linear":
+                mult = 1.0 + (maxmult - 1) * nup / nwup
+            elif strategy == "power2":
+                mult = 1.0 + (maxmult - 1) * (nup * nup) / (nwup * nwup)
+            elif strategy == "sqrt":
+                mult = 1.0 + (maxmult - 1) * math.sqrt(float(nup) / nwup)
+            else:
+                mult = 1.0
+        return mult
+
+    def _get_lars(self, weight, g, wd):
+        """LARS trust ratio (reference: optimizer.py LBSGD._get_lars)."""
+        import jax.numpy as jnp
+        w_norm = float(jnp.linalg.norm(weight._data.ravel()))
+        g_norm = float(jnp.linalg.norm(g.ravel()))
+        if w_norm > 0.0 and g_norm > 0.0:
+            return w_norm / (g_norm + wd * w_norm + 1e-9)
+        return 1.0
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        if self.warmup_strategy == "lars":
+            lbmult = self._get_lars(weight, g, wd)
+        else:
+            lbmult = self._get_lbmult(self.num_update)
+        lr = lr * lbmult
+        if state is not None:
+            state._data = self.momentum * state._data - \
+                lr * (g + wd * weight._data)
+            weight._data = weight._data + state._data
+        else:
+            weight._data = weight._data - lr * (g + wd * weight._data)
+
+
+@register
+class Test(Optimizer):
+    """(reference: optimizer.py:1205)"""
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        weight._data = weight._data - self.rescale_grad * grad._data
+        state._data = weight._data
+
+
+class Updater:
+    """Applies an optimizer to (index, grad, weight) triples, owning states
+    (reference: optimizer.py:1452-1506). This is the object given to the
+    KVStore as the server-side updater."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def set_states(self, states):
+        """Deserialize states (reference: optimizer.py:1490)."""
+        states = pickle.loads(states) if isinstance(states, bytes) else states
+        if isinstance(states, tuple) and len(states) == 2:
+            states, self.optimizer = states
+
+        def to_nd(s):
+            if isinstance(s, np.ndarray):
+                return _nd_mod.array(s)
+            if isinstance(s, (tuple, list)):
+                return type(s)(to_nd(x) for x in s)
+            return s
+
+        self.states = {k: to_nd(v) for k, v in states.items()}
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        """Serialize states (reference: optimizer.py:1500)."""
+        def to_np(s):
+            if isinstance(s, NDArray):
+                return s.asnumpy()
+            if isinstance(s, (tuple, list)):
+                return type(s)(to_np(x) for x in s)
+            return s
+        states = {k: to_np(v) for k, v in self.states.items()}
+        return pickle.dumps((states, self.optimizer) if dump_optimizer
+                            else states)
+
+
+def get_updater(optimizer):
+    """(reference: optimizer.py:1507)"""
+    return Updater(optimizer)

@@ -11,20 +11,53 @@ import threading
 
 import jax
 
-__all__ = ["seed", "next_key", "current_key"]
+__all__ = ["seed", "next_key", "current_key", "numpy_rng"]
 
 _lock = threading.Lock()
 _key = [None]  # lazy: creating a key at import time would init the backend
+_np_rng = [None]  # host-side generator for initializers (reference seeds both)
 
 
 def seed(seed_state: int, ctx="all"):
     """Seed the global generator (reference: python/mxnet/random.py:28)."""
+    import numpy as np
     with _lock:
         _key[0] = jax.random.PRNGKey(int(seed_state))
+        _np_rng[0] = np.random.RandomState(int(seed_state))
+
+
+def numpy_rng():
+    """Host RNG used by initializers (weights are built host-side then
+    device_put — init is a one-time cost, not a TPU hot path)."""
+    import numpy as np
+    with _lock:
+        if _np_rng[0] is None:
+            _np_rng[0] = np.random.RandomState(0)
+        return _np_rng[0]
+
+
+_trace_keys = threading.local()  # stack of traced keys during jit staging
+
+
+def push_trace_key(key):
+    """Enter a traced-RNG scope: ``next_key()`` splits from this traced key
+    instead of the global host state (used by hybridize/jit staging so
+    Dropout masks differ per call of the compiled function)."""
+    if not hasattr(_trace_keys, "stack"):
+        _trace_keys.stack = []
+    _trace_keys.stack.append(key)
+
+
+def pop_trace_key():
+    _trace_keys.stack.pop()
 
 
 def next_key():
     """Split and return a fresh subkey (thread-safe)."""
+    stack = getattr(_trace_keys, "stack", None)
+    if stack:
+        stack[-1], sub = jax.random.split(stack[-1])
+        return sub
     with _lock:
         if _key[0] is None:
             _key[0] = jax.random.PRNGKey(0)

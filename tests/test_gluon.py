@@ -1,0 +1,290 @@
+"""Gluon Block/Parameter/layer tests.
+
+Modeled on the reference's tests/python/unittest/test_gluon.py: parameter
+lifecycle, deferred init, hybridize consistency (eager vs staged/jit — the
+TPU analog of the reference's hybridize tests), save/load roundtrips.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = mx.gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=[mx.cpu(0)])
+    assert len(p.list_data()) == 1
+    assert len(p.list_grad()) == 1
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+
+
+def test_parameter_invalid_access():
+    p = mx.gluon.Parameter("weight", shape=(10, 10))
+    with pytest.raises(RuntimeError):
+        p.data()
+
+
+def test_paramdict(tmp_path):
+    params = mx.gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu())
+    f = str(tmp_path / "test_paramdict.params")
+    params.save(f)
+    params.load(f, mx.cpu())
+
+
+def test_paramdict_shape_conflict():
+    params = mx.gluon.ParameterDict("net_")
+    params.get("w", shape=(3, 4))
+    with pytest.raises(AssertionError):
+        params.get("w", shape=(3, 5))
+
+
+def test_trainer_stale_grad():
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+    with pytest.raises(UserWarning):
+        trainer.step(1)  # no backward ran
+    x = mx.nd.ones((2, 3))
+    with mx.autograd.record():
+        net(x).sum().backward()
+    trainer.step(2)  # ok
+    with pytest.raises(UserWarning):
+        trainer.step(2)  # stale again
+    trainer.step(2, ignore_stale_grad=True)  # suppressed
+
+
+def test_constant():
+    class Test(mx.gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.value = np.asarray([[1, 2], [3, 4]], dtype="float32")
+            self.const = self.params.get_constant("const", self.value)
+
+        def hybrid_forward(self, F, x, const):
+            return x + const
+
+    test = Test()
+    test.initialize()
+    trainer = mx.gluon.Trainer(test.collect_params(), "sgd",
+                               {"learning_rate": 1.0, "momentum": 0.5})
+
+    with mx.autograd.record():
+        x = mx.nd.ones((2, 2))
+        x.attach_grad()
+        y = test(x)
+        y.backward()
+
+    trainer.step(1)
+    assert (test.const.data().asnumpy() == test.value).all()
+    assert (x.grad.asnumpy() == 1).all()
+
+
+def test_basic_blocks():
+    model = nn.Sequential()
+    model.add(nn.Dense(128, activation="tanh", in_units=10, flatten=False))
+    model.add(nn.Dropout(0.5))
+    model.add(nn.Dense(64, activation="tanh", in_units=256))
+    model.add(nn.Dense(32, in_units=64))
+    model.add(nn.Activation("relu"))
+
+    # symbol-free: just check forward shape
+    model.initialize()
+    x = mx.nd.zeros((32, 2, 10))
+    out = model(x)
+    assert out.shape == (32, 32)
+
+
+def test_dense():
+    model = nn.Dense(128, activation="tanh", in_units=10, flatten=False,
+                     prefix="test_")
+    inputs = mx.nd.zeros((2, 3, 10))
+    model.initialize()
+    out = model(inputs)
+    assert out.shape == (2, 3, 128)
+    assert list(model.collect_params().keys()) == \
+        ["test_weight", "test_bias"]
+
+    model = nn.Dense(128, activation="relu", in_units=30, flatten=True,
+                     prefix="test2_")
+    inputs = mx.nd.zeros((17, 2, 5, 3))
+    model.initialize()
+    out = model(inputs)
+    assert out.shape == (17, 128)
+
+
+def test_deferred_init():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(10, 3), nn.Dense(5))
+    net.initialize()
+    x = mx.nd.ones((2, 3, 8, 8))
+    out = net(x)
+    assert out.shape == (2, 5)
+    assert net[0].weight.shape == (10, 3, 3, 3)
+
+
+def test_hybrid_eager_consistency():
+    """Staged (jit) execution must match eager — the TPU analog of the
+    reference's CachedOp-vs-imperative checks."""
+    def build():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+                    nn.BatchNorm(),
+                    nn.MaxPool2D(2, 2),
+                    nn.Flatten(),
+                    nn.Dense(10))
+        return net
+
+    mx.random.seed(42)
+    net = build()
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(1).randn(4, 3, 8, 8)
+                    .astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    staged = net(x).asnumpy()
+    np.testing.assert_allclose(eager, staged, rtol=1e-5, atol=1e-5)
+
+
+def test_hybrid_gradients_match_eager():
+    mx.random.seed(7)
+    x_np = np.random.RandomState(2).randn(4, 6).astype(np.float32)
+    label_np = np.array([0, 1, 0, 1], np.float32)
+
+    grads = []
+    for hybrid in (False, True):
+        net = nn.HybridSequential(prefix=f"net{int(hybrid)}_")
+        with net.name_scope():
+            net.add(nn.Dense(4, activation="tanh"), nn.Dense(1))
+        net.initialize(mx.init.Constant(0.1))
+        if hybrid:
+            net.hybridize()
+        x = mx.nd.array(x_np)
+        label = mx.nd.array(label_np)
+        loss_fn = mx.gluon.loss.L2Loss()
+        with mx.autograd.record():
+            loss = loss_fn(net(x), label)
+        loss.backward()
+        g = {k.split("_", 1)[1]: v.grad().asnumpy()
+             for k, v in net.collect_params().items()}
+        grads.append(g)
+    for k in grads[0]:
+        np.testing.assert_allclose(grads[0][k], grads[1][k], rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(8, 4, 3, 3)
+                    .astype(np.float32) * 2 + 1)
+    with mx.autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert not np.allclose(rm, 0)  # moved toward batch mean
+    # inference uses running stats: output differs from training output
+    out_inf = bn(x).asnumpy()
+    with mx.autograd.record():
+        out_train = bn(x).asnumpy()
+    assert not np.allclose(out_inf, out_train)
+
+
+def test_block_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.ones((2, 4))
+    expected = net(x).asnumpy()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net2.load_parameters(f)
+    np.testing.assert_allclose(net2(x).asnumpy(), expected, rtol=1e-6)
+
+
+def test_losses():
+    pred = mx.nd.array([[1.0, 2.0, 3.0], [3.0, 2.0, 1.0]])
+    label = mx.nd.array([2, 0])
+    l = mx.gluon.loss.SoftmaxCrossEntropyLoss()(pred, label).asnumpy()
+    # manual: -log softmax at label index
+    logits = pred.asnumpy()
+    ref = -np.log(np.exp(logits[np.arange(2), [2, 0]]) /
+                  np.exp(logits).sum(1))
+    np.testing.assert_allclose(l, ref, rtol=1e-5)
+
+    p = mx.nd.array([[0.5, 1.5]])
+    t = mx.nd.array([[1.0, 1.0]])
+    np.testing.assert_allclose(
+        mx.gluon.loss.L2Loss()(p, t).asnumpy(), [0.125], rtol=1e-6)
+    np.testing.assert_allclose(
+        mx.gluon.loss.L1Loss()(p, t).asnumpy(), [0.5], rtol=1e-6)
+    h = mx.gluon.loss.HuberLoss(rho=1.0)(p, t).asnumpy()
+    assert h.shape == (1,)
+
+
+def test_conv_layers_shapes():
+    layers_specs = [
+        (nn.Conv1D(16, 3, in_channels=4), (2, 4, 10), (2, 16, 8)),
+        (nn.Conv2D(16, 3, strides=2, padding=1, in_channels=4),
+         (2, 4, 10, 10), (2, 16, 5, 5)),
+        (nn.Conv3D(8, 3, in_channels=2), (1, 2, 6, 6, 6), (1, 8, 4, 4, 4)),
+        (nn.Conv2DTranspose(8, 3, strides=2, in_channels=4),
+         (1, 4, 5, 5), (1, 8, 11, 11)),
+        (nn.MaxPool2D(2, 2), (1, 3, 8, 8), (1, 3, 4, 4)),
+        (nn.AvgPool2D(2, 2, padding=1), (1, 3, 8, 8), (1, 3, 5, 5)),
+        (nn.GlobalAvgPool2D(), (1, 3, 8, 8), (1, 3, 1, 1)),
+        (nn.GlobalMaxPool1D(), (1, 3, 8), (1, 3, 1)),
+    ]
+    for layer, in_shape, out_shape in layers_specs:
+        layer.initialize()
+        out = layer(mx.nd.ones(in_shape))
+        assert out.shape == out_shape, \
+            f"{layer}: {out.shape} != {out_shape}"
+
+
+def test_embedding():
+    layer = nn.Embedding(10, 4)
+    layer.initialize()
+    idx = mx.nd.array([0, 1, 9])
+    out = layer(idx)
+    assert out.shape == (3, 4)
+    with mx.autograd.record():
+        out = layer(idx)
+        out.sum().backward()
+    g = layer.weight.grad().asnumpy()
+    assert g[0].sum() != 0 and g[9].sum() != 0
+    assert g[2].sum() == 0  # unselected row gets no gradient
+
+
+def test_sequential_slicing():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4), nn.Dense(3), nn.Dense(2))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    sliced = net[1:]
+    assert len(sliced) == 2
+
+
+def test_apply_and_summary(capsys):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    seen = []
+    net.apply(lambda b: seen.append(b.name))
+    assert len(seen) == 3
+    net.summary()
+    assert "Params" in capsys.readouterr().out

@@ -1,0 +1,67 @@
+"""Data iterator tests (reference model: tests/python/unittest/test_io.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_NDArrayIter():
+    data = np.ones([1000, 2, 2])
+    label = np.ones([1000, 1])
+    for i in range(1000):
+        data[i] = i / 100
+        label[i] = i / 100
+    dataiter = mx.io.NDArrayIter(data, label, 128, True,
+                                 last_batch_handle="pad")
+    batchidx = 0
+    for batch in dataiter:
+        batchidx += 1
+    assert batchidx == 8
+    dataiter = mx.io.NDArrayIter(data, label, 128, False,
+                                 last_batch_handle="pad")
+    batchidx = 0
+    labelcount = [0] * 10
+    for batch in dataiter:
+        label = batch.label[0].asnumpy().flatten()
+        assert (batch.data[0].asnumpy()[:, 0, 0] == label).all()
+        for i in range(label.shape[0]):
+            labelcount[int(label[i])] += 1
+    for i in range(10):
+        if i == 0:
+            assert labelcount[i] == 124, labelcount[i]
+        else:
+            assert labelcount[i] == 100, labelcount[i]
+
+
+def test_NDArrayIter_discard():
+    data = np.ones([100, 2])
+    it = mx.io.NDArrayIter(data, np.ones([100]), 32,
+                           last_batch_handle="discard")
+    n = sum(1 for _ in it)
+    assert n == 3
+
+
+def test_NDArrayIter_provide():
+    it = mx.io.NDArrayIter(np.zeros((10, 3)), np.zeros((10,)), 5)
+    d = it.provide_data[0]
+    assert d.name == "data" and d.shape == (5, 3)
+    l = it.provide_label[0]
+    assert l.name == "softmax_label" and l.shape == (5,)
+
+
+def test_ResizeIter():
+    it = mx.io.NDArrayIter(np.zeros((20, 2)), np.zeros((20,)), 10)
+    rit = mx.io.ResizeIter(it, 5)
+    n = sum(1 for _ in rit)
+    assert n == 5
+
+
+def test_PrefetchingIter():
+    it = mx.io.NDArrayIter(np.arange(40).reshape(20, 2), np.zeros((20,)), 5)
+    pit = mx.io.PrefetchingIter(it)
+    batches = list(pit)
+    assert len(batches) == 4
+    pit.reset()
+    batches2 = list(pit)
+    assert len(batches2) == 4
+    np.testing.assert_array_equal(batches[0].data[0].asnumpy(),
+                                  batches2[0].data[0].asnumpy())

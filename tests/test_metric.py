@@ -1,0 +1,96 @@
+"""Metric tests (reference model: tests/python/unittest/test_metric.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_metrics_creatable():
+    names = ["acc", "accuracy", "ce", "f1", "mcc", "perplexity", "mae", "mse",
+             "rmse", "top_k_accuracy", "nll_loss", "pearsonr", "loss"]
+    for name in names:
+        kwargs = {}
+        if name == "perplexity":
+            kwargs = {"ignore_label": -1}
+        if name == "top_k_accuracy":
+            kwargs = {"top_k": 3}
+        metric = mx.metric.create(name, **kwargs)
+        assert isinstance(metric, mx.metric.EvalMetric)
+        mx.metric.create(metric.get_config()["metric"].lower(), **kwargs)
+
+
+def test_accuracy():
+    pred = mx.nd.array([[0.3, 0.7], [0, 1.], [0.4, 0.6]])
+    label = mx.nd.array([0, 1, 1])
+    metric = mx.metric.create("acc")
+    metric.update([label], [pred])
+    _, acc = metric.get()
+    assert acc == pytest.approx(2.0 / 3)
+
+
+def test_top_k_accuracy():
+    pred = mx.nd.array([[0.1, 0.2, 0.7], [0.5, 0.4, 0.1]])
+    label = mx.nd.array([1, 2])
+    metric = mx.metric.create("top_k_accuracy", top_k=2)
+    metric.update([label], [pred])
+    _, acc = metric.get()
+    assert acc == pytest.approx(0.5)
+
+
+def test_f1():
+    pred = mx.nd.array([[0.3, 0.7], [1., 0], [0.4, 0.6]])
+    label = mx.nd.array([0, 0, 1])
+    metric = mx.metric.create("f1")
+    metric.update([label], [pred])
+    _, f1 = metric.get()
+    # tp=1 fp=1 fn=0 → precision 0.5, recall 1 → f1 = 2/3
+    assert f1 == pytest.approx(2.0 / 3)
+
+
+def test_mse_mae_rmse():
+    pred = mx.nd.array([[1.0], [2.0]])
+    label = mx.nd.array([[0.0], [4.0]])
+    m = mx.metric.create("mse")
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx((1 + 4) / 2)
+    m = mx.metric.create("mae")
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(1.5)
+    m = mx.metric.create("rmse")
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(np.sqrt(2.5))
+
+
+def test_perplexity():
+    pred = mx.nd.array([[0.8, 0.2], [0.2, 0.8], [0.5, 0.5]])
+    label = mx.nd.array([0, 1, 0])
+    metric = mx.metric.create("perplexity", ignore_label=None)
+    metric.update([label], [pred])
+    _, ppl = metric.get()
+    expected = np.exp(-np.mean(np.log([0.8, 0.8, 0.5])))
+    assert ppl == pytest.approx(expected, rel=1e-5)
+
+
+def test_composite():
+    metric = mx.metric.create(["acc", "mse"])
+    pred = mx.nd.array([[0.3, 0.7], [0.6, 0.4]])
+    label = mx.nd.array([1, 0])
+    metric.update([label], [pred])
+    names, values = metric.get()
+    assert names == ["accuracy", "mse"]
+    assert values[0] == pytest.approx(1.0)
+
+
+def test_custom_metric():
+    def feval(label, pred):
+        return float(np.abs(label - pred).sum())
+
+    metric = mx.metric.CustomMetric(feval)
+    metric.update([mx.nd.array([1.0])], [mx.nd.array([0.5])])
+    assert metric.get()[1] == pytest.approx(0.5)
+
+
+def test_loss_metric():
+    metric = mx.metric.create("loss")
+    metric.update(None, [mx.nd.array([1.0, 3.0])])
+    assert metric.get()[1] == pytest.approx(2.0)

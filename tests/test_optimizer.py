@@ -1,0 +1,140 @@
+"""Optimizer tests — modeled on tests/python/unittest/test_optimizer.py in
+the reference: each optimizer must reduce a quadratic, and the Updater must
+serialize/restore state."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+
+ALL_OPTS = ["sgd", "nag", "adam", "adagrad", "rmsprop", "adadelta", "ftrl",
+            "adamax", "nadam", "signum", "ftml", "dcasgd", "sgld", "lbsgd"]
+
+
+def _run_opt(name, steps=200, **kwargs):
+    """Minimize ||w - 3||^2 from w=0."""
+    mx.random.seed(0)
+    w = mx.nd.array(np.zeros((4, 4), np.float32))
+    target = 3.0
+    optimizer = opt.create(name, **kwargs)
+    updater = opt.get_updater(optimizer)
+    for _ in range(steps):
+        grad = mx.nd.array(2 * (w.asnumpy() - target))
+        updater(0, grad, w)
+    return np.abs(w.asnumpy() - target).mean()
+
+
+@pytest.mark.parametrize("name", ALL_OPTS)
+def test_optimizer_decreases(name):
+    err0 = 3.0
+    kwargs = {}
+    if name in ("sgd", "nag", "signum", "lbsgd"):
+        kwargs = {"learning_rate": 0.05, "momentum": 0.9}
+    elif name == "sgld":
+        kwargs = {"learning_rate": 0.01}
+    elif name == "dcasgd":
+        kwargs = {"learning_rate": 0.05}
+    elif name in ("adam", "adamax", "nadam", "rmsprop"):
+        kwargs = {"learning_rate": 0.05}
+    elif name == "adagrad":
+        kwargs = {"learning_rate": 0.5}
+    elif name == "ftrl":
+        kwargs = {"learning_rate": 1.0}
+    elif name == "ftml":
+        kwargs = {"learning_rate": 0.5}
+    err = _run_opt(name, **kwargs)
+    assert err < err0 * 0.7, f"{name}: err {err}"
+
+
+def test_sgd_momentum_exact():
+    """One step of sgd_mom must match the reference formula
+    (src/operator/optimizer_op-inl.h SGDMom)."""
+    w = mx.nd.array(np.ones((2, 2), np.float32))
+    g = mx.nd.array(np.full((2, 2), 0.5, np.float32))
+    optimizer = opt.create("sgd", learning_rate=0.1, momentum=0.9, wd=0.01)
+    state = optimizer.create_state(0, w)
+    optimizer.update(0, w, g, state)
+    # mom = 0.9*0 - 0.1*(0.5 + 0.01*1) = -0.051 ; w = 1 - 0.051
+    np.testing.assert_allclose(w.asnumpy(), np.full((2, 2), 0.949),
+                               rtol=1e-6)
+
+
+def test_adam_exact():
+    w = mx.nd.array(np.ones((2,), np.float32))
+    g = mx.nd.array(np.array([0.1, 0.2], np.float32))
+    optimizer = opt.create("adam", learning_rate=0.1)
+    state = optimizer.create_state(0, w)
+    optimizer.update(0, w, g, state)
+    t = 1
+    lr = 0.1 * np.sqrt(1 - 0.999 ** t) / (1 - 0.9 ** t)
+    m = 0.1 * np.array([0.1, 0.2])
+    v = 0.001 * np.array([0.01, 0.04])
+    expected = 1 - lr * m / (np.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(w.asnumpy(), expected, rtol=1e-5)
+
+
+def test_lr_scheduler_factor():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    sched.base_lr = 1.0
+    assert sched(1) == 1.0
+    lr = sched(25)
+    assert lr == 0.5 or lr == 0.25  # at least one decay applied
+    optimizer = opt.create("sgd", learning_rate=1.0, lr_scheduler=sched)
+    assert optimizer.lr_scheduler is sched
+
+
+def test_lr_wd_mult():
+    optimizer = opt.create("sgd", learning_rate=1.0,
+                           param_idx2name={0: "w_weight", 1: "b_bias"},
+                           wd=0.1)
+    optimizer.set_lr_mult({"w_weight": 0.5})
+    assert optimizer._get_lr(0) == 0.5
+    assert optimizer._get_lr(1) == 1.0
+    # bias wd_mult defaults to 0
+    assert optimizer._get_wd(1) == 0.0
+    assert optimizer._get_wd(0) == pytest.approx(0.1)
+
+
+def test_updater_states_roundtrip():
+    w = mx.nd.array(np.ones((3,), np.float32))
+    g = mx.nd.array(np.full((3,), 0.1, np.float32))
+    optimizer = opt.create("adam")
+    updater = opt.get_updater(optimizer)
+    updater(0, g, w)
+    blob = updater.get_states(dump_optimizer=True)
+    updater2 = opt.get_updater(opt.create("adam"))
+    updater2.set_states(blob)
+    assert 0 in updater2.states
+
+
+def test_multi_precision():
+    import jax.numpy as jnp
+    w = mx.nd.array(np.ones((4,), np.float32)).astype("float16")
+    g = mx.nd.array(np.full((4,), 0.5, np.float32)).astype("float16")
+    optimizer = opt.create("sgd", learning_rate=0.1, momentum=0.9,
+                           multi_precision=True)
+    state = optimizer.create_state_multi_precision(0, w)
+    assert isinstance(state, opt._MPState)
+    assert state.master.dtype == np.float32
+    optimizer.update_multi_precision(0, w, g, state)
+    assert w.dtype == np.float16
+    np.testing.assert_allclose(w.asnumpy().astype(np.float32),
+                               np.full((4,), 0.95), rtol=1e-2)
+
+
+def test_trainer_states_save_load(tmp_path):
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 0.1})
+    x = mx.nd.ones((4, 3))
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(4)
+    f = str(tmp_path / "trainer.states")
+    trainer.save_states(f)
+    trainer.load_states(f)
+    assert trainer._optimizer.num_update >= 1

@@ -1,0 +1,94 @@
+"""End-to-end convergence tests — the analog of the reference's
+tests/python/train/test_mlp.py and test_conv.py: tiny trainings on synthetic
+data asserting an accuracy threshold (SURVEY.md §4 'train' tests)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn
+
+
+def make_moons(n=400, seed=0):
+    """Two interleaved half-circles — linearly inseparable."""
+    rng = np.random.RandomState(seed)
+    t = rng.uniform(0, np.pi, n // 2)
+    x1 = np.stack([np.cos(t), np.sin(t)], 1)
+    x2 = np.stack([1 - np.cos(t), 0.5 - np.sin(t)], 1)
+    x = np.concatenate([x1, x2]).astype(np.float32)
+    x += rng.normal(scale=0.1, size=x.shape).astype(np.float32)
+    y = np.concatenate([np.zeros(n // 2), np.ones(n // 2)]).astype(np.float32)
+    idx = rng.permutation(n)
+    return x[idx], y[idx]
+
+
+@pytest.mark.parametrize("hybridize", [False, True])
+def test_mlp_convergence(hybridize):
+    mx.random.seed(0)
+    x, y = make_moons()
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"),
+                nn.Dense(32, activation="relu"),
+                nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    if hybridize:
+        net.hybridize()
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 0.01})
+    it = mx.io.NDArrayIter(x, y, batch_size=50, shuffle=True)
+    metric = mx.metric.Accuracy()
+    for epoch in range(12):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            data, label = batch.data[0], batch.label[0]
+            with mx.autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update([label], [out])
+    _, acc = metric.get()
+    assert acc > 0.95, f"accuracy {acc}"
+
+
+def test_lenet_convergence():
+    """Synthetic 'MNIST': each class is a distinct stripe pattern + noise."""
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    n, ncls = 256, 4
+    x = np.zeros((n, 1, 16, 16), np.float32)
+    y = rng.randint(0, ncls, n)
+    for i in range(n):
+        x[i, 0, :, y[i] * 4:(y[i] + 1) * 4] = 1.0
+    x += rng.normal(scale=0.3, size=x.shape).astype(np.float32)
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, activation="relu"),
+                nn.MaxPool2D(2, 2),
+                nn.Flatten(),
+                nn.Dense(32, activation="relu"),
+                nn.Dense(ncls))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 0.01})
+    it = mx.io.NDArrayIter(x, y.astype(np.float32), batch_size=32,
+                           shuffle=True)
+    metric = mx.metric.Accuracy()
+    for epoch in range(6):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            data, label = batch.data[0], batch.label[0]
+            with mx.autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update([label], [out])
+    _, acc = metric.get()
+    assert acc > 0.9, f"accuracy {acc}"
