@@ -1,0 +1,63 @@
+"""Flagship benchmark: ResNet-50 ImageNet-shape training throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's published ResNet-50 training throughput of
+181.53 img/s on 1x P100 (docs/faq/perf.md:176-185, BASELINE.md) — the best
+single-accelerator number in the reference repo. This bench runs the same
+workload (bs=32-class training step, 224x224, bf16 compute) on one TPU chip
+through the fused TrainStep path.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 181.53  # 1x P100, reference docs/faq/perf.md:176-185
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from mxnet_tpu.parallel import TrainStep
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+
+    mx.random.seed(0)
+    net = resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, 3, 224, 224).astype(np.float32)
+    y = rng.randint(0, 1000, (batch,))
+
+    step = TrainStep(net, loss="softmax_ce", optimizer="sgd",
+                     optimizer_params={"momentum": 0.9}, lr=0.1,
+                     compute_dtype="bfloat16")
+
+    # warmup / compile
+    for _ in range(3):
+        loss = step(x, y)
+    loss.wait_to_read()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    img_s = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput_per_chip",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,13 @@ from . import autograd
 from . import random
 from .random import seed
 from . import name
+from . import attribute
+from .attribute import AttrScope
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import executor
+from .executor import Executor
 from . import initializer
 from . import initializer as init
 from . import optimizer
@@ -33,5 +40,10 @@ from . import metric
 from . import io
 from . import kvstore
 from . import kvstore as kv
+from . import callback
+from . import model
+from . import module
+from . import module as mod
 from . import gluon
+from . import parallel
 from .io import DataBatch, DataIter

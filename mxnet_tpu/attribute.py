@@ -1,0 +1,51 @@
+"""Attribute scoping for symbols (reference: python/mxnet/attribute.py).
+
+``with mx.AttrScope(ctx_group='dev1'):`` annotates symbols created inside;
+the reference's PlaceDevice pass reads ``__ctx_group__`` for model
+parallelism (graph_executor.cc:406) — here the annotation maps to sharding
+hints consumed by the parallel layer.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current_attrs"]
+
+
+class AttrScope:
+    """(reference: attribute.py:27)"""
+
+    _current = threading.local()
+
+    def __init__(self, **kwargs):
+        self._old_scope = None
+        for value in kwargs.values():
+            if not isinstance(value, str):
+                raise ValueError("Attributes need to be a string")
+        self._attr = {f"__{k}__" if not k.startswith("__") else k: v
+                      for k, v in kwargs.items()}
+
+    def get(self, attr=None):
+        if attr:
+            ret = self._attr.copy()
+            ret.update(attr)
+            return ret
+        return self._attr.copy()
+
+    def __enter__(self):
+        self._old_scope = getattr(AttrScope._current, "value", None)
+        attr = self._attr.copy()
+        if self._old_scope is not None:
+            merged = self._old_scope._attr.copy()
+            merged.update(attr)
+            self._attr = merged
+        AttrScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        AttrScope._current.value = self._old_scope
+
+
+def current_attrs():
+    scope = getattr(AttrScope._current, "value", None)
+    return scope._attr.copy() if scope is not None else {}

@@ -1,0 +1,251 @@
+"""Executor: bound symbolic computation.
+
+TPU-native rebuild of ``mxnet.executor`` + the native GraphExecutor
+(reference: python/mxnet/executor.py — forward :113, backward :154,
+reshape :371; src/executor/graph_executor.cc).
+
+Architectural mapping: the reference compiles the graph at bind time
+(memory planning, op attachment, segment bulking) and pushes cached engine
+ops per batch. Here bind builds ONE jitted forward function and ONE jitted
+forward+backward function (via jax.vjp over the whole graph) — XLA is the
+memory planner and scheduler; "bulking" is total.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, _wrap
+
+__all__ = ["Executor"]
+
+# output-layer ops whose backward is the gradient of an implicit loss
+# (reference: src/operator/softmax_output.cc, regression_output.cc)
+_IMPLICIT_LOSS = {}
+
+
+def _register_implicit_losses():
+    import jax.numpy as jnp
+    from .ops import nn as _nn
+
+    def linreg_loss(data, label, grad_scale=1.0, **kw):
+        return grad_scale * 0.5 * jnp.sum(
+            jnp.square(data - label.reshape(data.shape)))
+
+    def maereg_loss(data, label, grad_scale=1.0, **kw):
+        return grad_scale * jnp.sum(jnp.abs(data - label.reshape(data.shape)))
+
+    def logreg_loss(data, label, grad_scale=1.0, **kw):
+        # grad = sigmoid(x) - y
+        x = data
+        y = label.reshape(data.shape)
+        return grad_scale * jnp.sum(
+            jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x))))
+
+    _IMPLICIT_LOSS.update({
+        "SoftmaxOutput": _nn.softmax_output_loss,
+        "Softmax": _nn.softmax_output_loss,
+        "LinearRegressionOutput": linreg_loss,
+        "MAERegressionOutput": maereg_loss,
+        "LogisticRegressionOutput": logreg_loss,
+    })
+
+
+class Executor:
+    """A bound computation graph (reference: executor.py:30)."""
+
+    def __init__(self, symbol, ctx, arg_dict: Dict[str, NDArray],
+                 args_grad: Optional[Dict[str, NDArray]], grad_req,
+                 aux_dict: Dict[str, NDArray]):
+        if not _IMPLICIT_LOSS:
+            _register_implicit_losses()
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_dict = dict(arg_dict)
+        self.aux_dict = dict(aux_dict or {})
+        self.grad_dict = dict(args_grad or {})
+        if isinstance(grad_req, str):
+            self.grad_req = {n: grad_req for n in symbol.list_arguments()}
+        else:
+            self.grad_req = dict(grad_req)
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+        self.outputs: List[NDArray] = []
+        self._monitor_callback = None
+        self._fwd_jit = None
+        self._vjp_fn = None
+        self._is_train = False
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self.arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self.arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self.aux_names]
+
+    # -- compilation ----------------------------------------------------------
+    def _build(self):
+        import jax
+
+        sym = self._symbol
+        arg_names = self.arg_names
+        aux_names = self.aux_names
+
+        def fwd(arg_vals, aux_vals):
+            amap = dict(zip(arg_names, arg_vals))
+            amap.update(zip(aux_names, aux_vals))
+            return tuple(sym.eval_arrays(amap))
+
+        self._fwd_jit = jax.jit(fwd)
+
+        # implicit-loss backward: sum of per-head implicit losses + explicit
+        # head-gradient path for other outputs
+        heads = sym._output_symbols()
+        loss_specs = []
+        for i, h in enumerate(heads):
+            node = h._node
+            if node.op in _IMPLICIT_LOSS:
+                from .ops.registry import parse_attr
+                attrs = {k: parse_attr(v) for k, v in node.attrs.items()
+                         if not k.startswith("__")}
+                loss_specs.append((i, node, attrs))
+        self._loss_specs = loss_specs
+
+        def fwd_loss(arg_vals, aux_vals, head_grads):
+            """Returns scalar pseudo-loss whose grad wrt args is the
+            backward of the graph with implicit losses + sum(out*head_grad)
+            for explicit heads."""
+            import jax.numpy as jnp
+            amap = dict(zip(arg_names, arg_vals))
+            amap.update(zip(aux_names, aux_vals))
+            outs = sym.eval_arrays(amap)
+            total = jnp.zeros((), jnp.float32)
+            implicit = {i for i, _, _ in loss_specs}
+            for i, node, attrs in loss_specs:
+                # recompute the loss from the head node's *inputs*
+                ins = []
+                for p, oi in node.inputs:
+                    sub = type(sym)(p, oi)
+                    ins.append(sub.eval_arrays(amap)[0])
+                total = total + _IMPLICIT_LOSS[node.op](*ins, **attrs)
+            for i, o in enumerate(outs):
+                if i not in implicit and head_grads is not None and \
+                        head_grads[i] is not None:
+                    total = total + jnp.sum(o * head_grads[i])
+            return total, tuple(outs)
+
+        self._fwd_loss_grad = jax.jit(jax.grad(fwd_loss, argnums=0,
+                                               has_aux=True))
+
+    # -- execution ------------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        """(reference: executor.py:113)"""
+        if kwargs:
+            for name, arr in kwargs.items():
+                if name not in self.arg_dict:
+                    raise MXNetError(f"Unknown argument {name}")
+                if isinstance(arr, NDArray):
+                    self.arg_dict[name]._data = arr._data
+                else:
+                    import jax.numpy as jnp
+                    self.arg_dict[name]._data = jnp.asarray(arr)
+        if self._fwd_jit is None:
+            self._build()
+        self._is_train = is_train
+        arg_vals = tuple(self.arg_dict[n]._data for n in self.arg_names)
+        aux_vals = tuple(self.aux_dict[n]._data for n in self.aux_names)
+        outs = self._fwd_jit(arg_vals, aux_vals)
+        self.outputs = [_wrap(o) for o in outs]
+        if self._monitor_callback is not None:
+            for name, o in zip(self.output_names, self.outputs):
+                self._monitor_callback(name, o)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        """(reference: executor.py:154; grads accumulate per grad_req)"""
+        if self._fwd_jit is None:
+            self._build()
+        import jax.numpy as jnp
+        arg_vals = tuple(self.arg_dict[n]._data for n in self.arg_names)
+        aux_vals = tuple(self.aux_dict[n]._data for n in self.aux_names)
+        if out_grads is None:
+            head_grads = None
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            head_grads = tuple(
+                g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                for g in out_grads)
+        grads, outs = self._fwd_loss_grad(arg_vals, aux_vals, head_grads)
+        self.outputs = [_wrap(o) for o in outs]
+        for name, g in zip(self.arg_names, grads):
+            req = self.grad_req.get(name, "null")
+            if req == "null" or name not in self.grad_dict:
+                continue
+            tgt = self.grad_dict[name]
+            if req == "add":
+                tgt._data = tgt._data + g
+            else:
+                tgt._data = g
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """(reference: executor.py set_monitor_callback;
+        GraphExecutor graph_executor.cc:121)"""
+        self._monitor_callback = callback
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """(reference: executor.py:326)"""
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._data = array._data \
+                    if isinstance(array, NDArray) else array
+            elif not allow_extra_params:
+                raise ValueError(f"Found name \"{name}\" that is not in the "
+                                 "arguments")
+        if aux_params:
+            for name, array in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._data = array._data \
+                        if isinstance(array, NDArray) else array
+                elif not allow_extra_params:
+                    raise ValueError(f"Found name \"{name}\" that is not in "
+                                     "the auxiliary states")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor for new input shapes (reference:
+        executor.py:371). XLA recompiles per shape — this is the
+        BucketingModule mechanism."""
+        from . import ndarray as nd
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {}
+        for name, s in zip(self.arg_names, arg_shapes):
+            old = self.arg_dict[name]
+            if tuple(old.shape) == tuple(s):
+                new_args[name] = old
+            else:
+                new_args[name] = nd.zeros(s, ctx=self._ctx)
+        new_grads = {}
+        if self.grad_dict:
+            for name, s in zip(self.arg_names, arg_shapes):
+                if name in self.grad_dict:
+                    new_grads[name] = nd.zeros(s, ctx=self._ctx)
+        new_aux = {}
+        for name, s in zip(self.aux_names, aux_shapes):
+            old = self.aux_dict[name]
+            new_aux[name] = old if tuple(old.shape) == tuple(s) \
+                else nd.zeros(s, ctx=self._ctx)
+        return Executor(self._symbol, self._ctx, new_args, new_grads,
+                        self.grad_req, new_aux)
+
+    @property
+    def output_dict(self):
+        return dict(zip(self.output_names, self.outputs))

@@ -9,3 +9,4 @@ from . import loss
 from . import trainer
 from .trainer import Trainer
 from . import utils
+from . import model_zoo

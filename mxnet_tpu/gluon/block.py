@@ -123,6 +123,9 @@ class Block:
                 self._reg_params[name] is value, \
                 "Overriding Parameter attribute %s is not allowed." % name
             self._reg_params[name] = value
+            # directly-assigned Parameters also live in the ParameterDict so
+            # sharing via params= sees them (reference: block.py __setattr__)
+            self._params._params.setdefault(value.name, value)
         super().__setattr__(name, value)
 
     def _alias(self):
@@ -142,13 +145,10 @@ class Block:
 
     @property
     def params(self):
-        """ParameterDict of parameters registered directly on this block."""
-        ret = ParameterDict(self._params.prefix)
-        for p in self._reg_params.values():
-            ret._params[p.name] = p
-        for n, p in self._params.items():
-            ret._params.setdefault(n, p)
-        return ret
+        """The Block's ParameterDict — the live dict (with its shared-dict
+        link intact), not a copy, so ``params=other.collect_params()``
+        sharing works (reference: block.py:245)."""
+        return self._params
 
     def collect_params(self, select=None):
         """ParameterDict of this Block and all children

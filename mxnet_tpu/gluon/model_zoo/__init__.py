@@ -1,0 +1,3 @@
+"""Model zoo (reference: python/mxnet/gluon/model_zoo/__init__.py)."""
+from . import vision
+from .model_store import get_model_file, purge

@@ -1,0 +1,43 @@
+"""Pretrained model file store (reference:
+python/mxnet/gluon/model_zoo/model_store.py).
+
+The reference downloads sha1-verified .params files from S3. This
+environment has no network egress; models are resolved from a local root
+(``MXNET_TPU_MODEL_ZOO`` env or ``~/.mxnet_tpu/models``) so users can drop
+converted checkpoints in place.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_model_file", "purge"]
+
+_model_sha1 = {}  # name -> sha1, populated as checkpoints are converted
+
+
+def get_model_root():
+    return os.path.expanduser(
+        os.environ.get("MXNET_TPU_MODEL_ZOO", "~/.mxnet_tpu/models"))
+
+
+def get_model_file(name, root=None):
+    """Return the path of a pretrained model parameters file
+    (reference: model_store.py:68)."""
+    root = root or get_model_root()
+    file_path = os.path.join(root, f"{name}.params")
+    if os.path.exists(file_path):
+        return file_path
+    raise FileNotFoundError(
+        f"Pretrained model file {file_path} is not found. This environment "
+        "has no network egress; place a converted checkpoint at that path "
+        "(see tools/convert_params.py) or construct the model with "
+        "pretrained=False.")
+
+
+def purge(root=None):
+    """Remove cached pretrained models (reference: model_store.py:97)."""
+    root = root or get_model_root()
+    if os.path.isdir(root):
+        for f in os.listdir(root):
+            if f.endswith(".params"):
+                os.remove(os.path.join(root, f))

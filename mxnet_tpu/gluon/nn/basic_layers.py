@@ -13,7 +13,7 @@ from .activations import Activation
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
            "BatchNorm", "InstanceNorm", "LayerNorm", "Flatten", "Lambda",
-           "HybridLambda"]
+           "HybridLambda", "HybridConcurrent", "Concurrent", "Identity"]
 
 
 class Sequential(Block):
@@ -131,8 +131,9 @@ class Dense(HybridBlock):
 
     def __repr__(self):
         shape = self.weight.shape
-        return (f"{self.__class__.__name__}({shape[0]} -> "
-                f"{shape[1] if len(shape) > 1 else None}, "
+        return (f"{self.__class__.__name__}"
+                f"({shape[1] if len(shape) > 1 and shape[1] else None} -> "
+                f"{shape[0]}, "
                 f"{'linear' if self.act is None else self.act._act_type})")
 
 
@@ -317,6 +318,35 @@ class Flatten(HybridBlock):
 
     def __repr__(self):
         return self.__class__.__name__
+
+
+class HybridConcurrent(HybridBlock):
+    """Run children on the same input and concat their outputs
+    (reference: python/mxnet/gluon/contrib/nn/basic_layers.py
+    HybridConcurrent; used by squeezenet/densenet/inception)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Concurrent(HybridConcurrent):
+    """Non-hybrid alias (reference: contrib/nn Concurrent)."""
+
+
+class Identity(HybridBlock):
+    """(reference: contrib/nn Identity)"""
+
+    def hybrid_forward(self, F, x):
+        return x
 
 
 class Lambda(Block):

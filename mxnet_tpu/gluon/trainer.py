@@ -70,11 +70,22 @@ class Trainer:
             self._kvstore = self._kvstore_type
         elif self._kvstore_type:
             self._kvstore = kvs.create(self._kvstore_type)
-        if self._kvstore is not None and self._update_on_kvstore is not False \
-                and self._kvstore.is_distributed:
-            self._kvstore.set_optimizer(self._optimizer)
-            self._update_on_kvstore = True
+        # single-logical-device training needs no store round-trip (the mesh
+        # handles cross-chip reduction inside the step); the kvstore engages
+        # only for dist types or an explicit update_on_kvstore=True
+        use_kv = self._kvstore is not None and \
+            (self._kvstore.is_distributed or self._update_on_kvstore is True)
+        if use_kv:
+            if self._update_on_kvstore is not False:
+                self._kvstore.set_optimizer(self._optimizer)
+                self._update_on_kvstore = True
+            else:
+                self._update_on_kvstore = False
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    self._kvstore.init(i, param.list_data())
         else:
+            self._kvstore = None
             self._update_on_kvstore = False
         self._kv_initialized = True
 
@@ -99,13 +110,20 @@ class Trainer:
     def _all_reduce_grads(self):
         """Cross-device gradient reduction. Single-controller TPU training
         shards the batch inside the jitted step, where psum already averaged
-        the grads; multi-process mode reduces here via the kvstore facade."""
-        if self._kvstore is not None and self._kvstore.is_distributed \
-                and not self._update_on_kvstore:
-            for i, param in enumerate(self._params):
-                if param.grad_req != "null":
-                    self._kvstore.push(i, param.list_grad(), priority=-i)
-                    self._kvstore.pull(i, param.list_grad(), priority=-i)
+        the grads; multi-process/kvstore mode reduces here via the facade
+        (reference: trainer.py:190 — push with priority=-i, pull back)."""
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            self._kvstore.push(i, param.list_grad(), priority=-i)
+            if self._update_on_kvstore:
+                # server-side optimizer already applied the update; pull the
+                # fresh weights (reference: model.py:126 _update_params_on_kvstore)
+                self._kvstore.pull(i, param.list_data(), priority=-i)
+            else:
+                self._kvstore.pull(i, param.list_grad(), priority=-i)
 
     def _update(self, ignore_stale_grad=False):
         updater = self._updaters[0]

@@ -84,7 +84,7 @@ class EvalMetric:
         self.reset()
 
     def __str__(self):
-        return f"EvalMetric: {dict(zip(*self.get()))}"
+        return f"EvalMetric: {dict(self.get_name_value())}"
 
     def get_config(self):
         config = self._kwargs.copy()

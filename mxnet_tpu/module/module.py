@@ -1,0 +1,403 @@
+"""Module: symbolic training on one logical device.
+
+TPU-native rebuild of ``mxnet.module.module`` (reference:
+python/mxnet/module/module.py — bind :363, init_optimizer :472,
+forward/backward/update :570-651).
+
+Architectural mapping: the reference binds one executor per GPU via
+DataParallelExecutorGroup (executor_group.py:129) and reduces gradients
+through KVStore. Here there is ONE executor whose arrays can be sharded
+over the mesh — the executor-group/KVStore machinery collapses into GSPMD.
+The ctx list argument is accepted for API parity; multiple ctx entries mean
+"shard the batch over the mesh".
+"""
+from __future__ import annotations
+
+import logging
+import warnings
+
+import numpy as np
+
+from .. import context as ctx_mod
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..io import DataDesc
+from ..model import load_checkpoint, save_checkpoint
+from .base_module import BaseModule, _check_input_names
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    """(reference: module.py:45)"""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = ctx_mod.current_context()
+        if isinstance(context, ctx_mod.Context):
+            context = [context]
+        self._context = context
+        self._symbol = symbol
+        self._data_names = list(data_names) if data_names is not None else []
+        self._label_names = list(label_names) if label_names is not None \
+            else []
+        self._state_names = list(state_names) if state_names is not None \
+            else []
+        self._fixed_param_names = list(fixed_param_names) \
+            if fixed_param_names is not None else []
+        _check_input_names(symbol, self._data_names, "data", True)
+        _check_input_names(symbol, self._label_names, "label", False)
+        _check_input_names(symbol, self._state_names, "state", True)
+        _check_input_names(symbol, self._fixed_param_names, "fixed_param",
+                           True)
+        arg_names = symbol.list_arguments()
+        input_names = self._data_names + self._label_names + \
+            self._state_names
+        self._param_names = [x for x in arg_names if x not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+        self._compression_params = compression_params
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._exec = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._grad_req = None
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """(reference: module.py:126)"""
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = f"{prefix}-{epoch:04d}.states"
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """(reference: module.py:164)"""
+        self._symbol.save(f"{prefix}-symbol.json")
+        param_name = f"{prefix}-{epoch:04d}.params"
+        self.save_params(param_name)
+        logging.info("Saved checkpoint to \"%s\"", param_name)
+        if save_optimizer_states:
+            state_name = f"{prefix}-{epoch:04d}.states"
+            self.save_optimizer_states(state_name)
+
+    # -- properties -----------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return [(n, tuple(o.shape))
+                for n, o in zip(self._output_names, self._exec.outputs)] \
+            if self._exec.outputs else []
+
+    # -- params ---------------------------------------------------------------
+    def get_params(self):
+        """(reference: module.py:233)"""
+        assert self.binded and self.params_initialized
+        if self._params_dirty:
+            self._sync_params_from_devices()
+        return (self._arg_params, self._aux_params)
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        """(reference: module.py:255)"""
+        from .. import initializer as init_mod
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before initializing the parameters"
+        if initializer is None and (arg_params is None or force_init is False):
+            initializer = init_mod.Uniform(0.01)
+
+        if self._arg_params is None:
+            self._arg_params = {
+                name: nd.zeros(self._exec.arg_dict[name].shape)
+                for name in self._param_names}
+        if self._aux_params is None:
+            self._aux_params = {
+                name: nd.zeros(self._exec.aux_dict[name].shape)
+                for name in self._aux_names}
+
+        attrs = self._symbol.attr_dict()
+
+        def _impl(name, arr, cache):
+            if cache is not None and name in cache:
+                cache_arr = cache[name]
+                if cache_arr is not arr:
+                    if tuple(cache_arr.shape) != tuple(arr.shape):
+                        raise RuntimeError(
+                            f"Fail to load parameter {name} because of shape "
+                            f"mismatch: {cache_arr.shape} vs {arr.shape}")
+                    arr._data = cache_arr._data
+            elif not allow_missing or initializer is not None:
+                if initializer is not None:
+                    from ..initializer import InitDesc
+                    desc = InitDesc(name, attrs.get(name, None))
+                    initializer(desc, arr)
+            if cache is not None and name not in cache and not allow_missing:
+                raise RuntimeError(f"{name} is not presented")
+
+        for name, arr in sorted(self._arg_params.items()):
+            _impl(name, arr, arg_params)
+        for name, arr in sorted(self._aux_params.items()):
+            _impl(name, arr, aux_params)
+
+        self.params_initialized = True
+        self._params_dirty = False
+        self._copy_params_to_exec()
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        if not allow_missing:
+            self.init_params(initializer=None, arg_params=arg_params,
+                             aux_params=aux_params,
+                             allow_missing=allow_missing,
+                             force_init=force_init, allow_extra=allow_extra)
+            return
+        if self.params_initialized and not force_init:
+            warnings.warn("Parameters already initialized and force_init=False. "
+                          "set_params call ignored.", stacklevel=2)
+            return
+        for name, arr in (arg_params or {}).items():
+            if name in self._arg_params:
+                self._arg_params[name]._data = arr._data
+        for name, arr in (aux_params or {}).items():
+            if name in self._aux_params:
+                self._aux_params[name]._data = arr._data
+        self.params_initialized = True
+        self._params_dirty = False
+        self._copy_params_to_exec()
+
+    def _copy_params_to_exec(self):
+        for name in self._param_names:
+            if name in self._arg_params:
+                self._exec.arg_dict[name]._data = \
+                    self._arg_params[name]._data
+        for name in self._aux_names:
+            if name in self._aux_params:
+                self._exec.aux_dict[name]._data = \
+                    self._aux_params[name]._data
+
+    def _sync_params_from_devices(self):
+        """(reference: module.py:755)"""
+        for name in self._param_names:
+            self._arg_params[name]._data = self._exec.arg_dict[name]._data
+        for name in self._aux_names:
+            self._aux_params[name]._data = self._exec.aux_dict[name]._data
+        self._params_dirty = False
+
+    # -- bind -----------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """(reference: module.py:363)"""
+        if force_rebind:
+            self._exec = None
+            self.binded = False
+        if self.binded:
+            self.logger.warning("Already binded, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+        def norm(shapes):
+            out = []
+            for s in shapes or []:
+                if isinstance(s, DataDesc):
+                    out.append((s.name, tuple(s.shape)))
+                else:
+                    out.append((s[0], tuple(s[1])))
+            return out
+
+        self._data_shapes = norm(data_shapes)
+        self._label_shapes = norm(label_shapes) if label_shapes else []
+        shape_kwargs = dict(self._data_shapes + self._label_shapes)
+        if not for_training:
+            grad_req = "null"
+        self._grad_req = grad_req
+        shared_buffer = shared_module._exec.arg_dict \
+            if shared_module is not None else None
+        self._exec = self._symbol.simple_bind(
+            ctx=self._context[0], grad_req=grad_req,
+            shared_buffer=shared_buffer, **shape_kwargs)
+        self.binded = True
+        if self.params_initialized:
+            # params were loaded before bind (Module.load path,
+            # reference: module.py:441 set_params into fresh executors)
+            self._copy_params_to_exec()
+        if shared_module is not None and shared_module.params_initialized:
+            self._arg_params = shared_module._arg_params
+            self._aux_params = shared_module._aux_params
+            self.params_initialized = True
+            self._copy_params_to_exec()
+
+    # -- optimizer ------------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """(reference: module.py:472; update decision model.py:58-95)"""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring...")
+            return
+        if isinstance(optimizer, str):
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer_params = dict(optimizer_params)
+            optimizer = opt.create(optimizer, param_idx2name=idx2name,
+                                   **optimizer_params)
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+        from .. import kvstore as kvs
+        if kvstore:
+            kv = kvs.create(kvstore) if isinstance(kvstore, str) else kvstore
+            self._kvstore = kv
+            self._update_on_kvstore = kv.is_distributed
+            if self._update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+            for i, name in enumerate(self._param_names):
+                kv.init(i, self._arg_params[name])
+        self.optimizer_initialized = True
+        if hasattr(self, "_preload_opt_states"):
+            self.load_optimizer_states(self._preload_opt_states)
+            del self._preload_opt_states
+
+    # -- compute --------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        """(reference: module.py:570)"""
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for (name, _), arr in zip(self._data_shapes, data_batch.data):
+            feed[name] = arr
+        if self._label_shapes and data_batch.label:
+            for (name, _), arr in zip(self._label_shapes, data_batch.label):
+                feed[name] = arr
+        # shape change (bucketing-style) → reshape executor
+        for name, arr in feed.items():
+            if tuple(self._exec.arg_dict[name].shape) != tuple(arr.shape):
+                new_shapes = {n: tuple(a.shape) for n, a in feed.items()}
+                self._exec = self._exec.reshape(**new_shapes)
+                break
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        """(reference: module.py:627)"""
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """(reference: module.py:629-651)"""
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        self._params_dirty = True
+        if self._kvstore is not None and self._update_on_kvstore:
+            for i, name in enumerate(self._param_names):
+                if self._grad_dict_req(name) == "null":
+                    continue
+                self._kvstore.push(i, self._exec.grad_dict[name],
+                                   priority=-i)
+                self._kvstore.pull(i, self._exec.arg_dict[name],
+                                   priority=-i)
+            return
+        for i, name in enumerate(self._param_names):
+            if self._grad_dict_req(name) == "null" or \
+                    name in self._fixed_param_names:
+                continue
+            self._updater(i, self._exec.grad_dict[name],
+                          self._exec.arg_dict[name])
+
+    def _grad_dict_req(self, name):
+        req = self._exec.grad_req
+        return req.get(name, "null") if isinstance(req, dict) else req
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized and \
+            self.inputs_need_grad
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        """(reference: module.py:736)"""
+        eval_metric.update_dict(
+            dict(zip(self._label_names, labels or [])),
+            dict(zip(self._output_names, self._exec.outputs)))
+
+    def install_monitor(self, mon):
+        assert self.binded
+        mon.install(self._exec)
+
+    # -- optimizer state io ----------------------------------------------------
+    def save_optimizer_states(self, fname):
+        """(reference: module.py:759)"""
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        """(reference: module.py:777)"""
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
+
+    def reshape(self, data_shapes, label_shapes=None):
+        """(reference: module.py:448)"""
+        assert self.binded
+        def norm(shapes):
+            out = []
+            for s in shapes or []:
+                if isinstance(s, DataDesc):
+                    out.append((s.name, tuple(s.shape)))
+                else:
+                    out.append((s[0], tuple(s[1])))
+            return out
+        self._data_shapes = norm(data_shapes)
+        self._label_shapes = norm(label_shapes) if label_shapes else []
+        kwargs = dict(self._data_shapes + self._label_shapes)
+        self._exec = self._exec.reshape(**kwargs)
+        self._copy_params_to_exec()

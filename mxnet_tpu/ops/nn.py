@@ -65,11 +65,12 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     dilate = _tup(dilate, sdims) or (1,) * sdims
     pad = _tup(pad, sdims) or (0,) * sdims
     dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dnums(nd))
+    # bf16 inputs: the TPU MXU accumulates in f32 natively; an explicit
+    # preferred_element_type breaks this JAX version's conv transpose rule
     out = jax.lax.conv_general_dilated(
-        data, weight, window_strides=stride,
+        data, weight.astype(data.dtype), window_strides=stride,
         padding=[(p, p) for p in pad], lhs_dilation=None, rhs_dilation=dilate,
-        dimension_numbers=dn, feature_group_count=int(num_group),
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
+        dimension_numbers=dn, feature_group_count=int(num_group))
     out = out.astype(data.dtype)
     if not no_bias and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * sdims)
@@ -255,9 +256,13 @@ def softmax_output_loss(data, label, grad_scale=1.0, ignore_label=-1.0,
         nll = nll * mask
         if normalization == "valid":
             return grad_scale * jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1.0)
-    if normalization == "batch" or normalization == "null":
+    # reference backward semantics (softmax_output.cc): "null" leaves each
+    # sample's (p - y) unscaled → implicit loss is the SUM of per-sample CE
+    # (the optimizer's rescale_grad=1/batch does the averaging); "batch"
+    # divides by batch size.
+    if normalization == "batch":
         return grad_scale * jnp.mean(nll)
-    return grad_scale * jnp.mean(nll)
+    return grad_scale * jnp.sum(nll)
 
 
 # ---------------------------------------------------------------------------

@@ -649,13 +649,13 @@ class LBSGD(Optimizer):
         return mult
 
     def _get_lars(self, weight, g, wd):
-        """LARS trust ratio (reference: optimizer.py LBSGD._get_lars)."""
+        """LARS trust ratio, fully traced — no host sync per parameter
+        (reference: optimizer.py LBSGD._get_lars)."""
         import jax.numpy as jnp
-        w_norm = float(jnp.linalg.norm(weight._data.ravel()))
-        g_norm = float(jnp.linalg.norm(g.ravel()))
-        if w_norm > 0.0 and g_norm > 0.0:
-            return w_norm / (g_norm + wd * w_norm + 1e-9)
-        return 1.0
+        w_norm = jnp.linalg.norm(weight._data.ravel())
+        g_norm = jnp.linalg.norm(g.ravel())
+        return jnp.where((w_norm > 0.0) & (g_norm > 0.0),
+                         w_norm / (g_norm + wd * w_norm + 1e-9), 1.0)
 
     def update(self, index, weight, grad, state):
         import jax.numpy as jnp

@@ -1,0 +1,119 @@
+"""Multi-process (multi-host) data parallelism.
+
+TPU-native replacement for the reference's parameter-server stack
+(reference: src/kvstore/kvstore_dist.h:44, kvstore_dist_server.h:113,
+3rdparty/ps-lite, tools/launch.py + dmlc-tracker bootstrap).
+
+Architectural mapping:
+- bootstrap: ``ps::StartAsync`` + scheduler rendezvous → ``init()`` /
+  ``jax.distributed.initialize`` (env: COORDINATOR_ADDRESS, NUM_PROCESSES,
+  PROCESS_ID — replacing DMLC_PS_ROOT_URI/DMLC_ROLE).
+- worker push/pull of float buffers over ZMQ → an all-reduce across
+  processes over DCN/ICI via a global mesh ``psum``.
+- server-side optimizer ("update_on_kvstore", kvstore_dist_server.h:187)
+  → every process applies the same optimizer to the all-reduced gradient;
+  there is no server role.
+- ``dist_async`` (no inter-worker barrier) has no XLA analog — collectives
+  are cooperative. It is emulated as sync (documented deviation; the
+  reference's own docs recommend sync for convergence).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..kvstore import KVStore
+from ..ndarray.ndarray import NDArray, _wrap
+
+__all__ = ["DistKVStore", "init", "barrier", "allreduce"]
+
+_initialized = [False]
+
+
+def init(coordinator=None, num_processes=None, process_id=None):
+    """Bootstrap multi-process JAX (reference analog: tools/launch.py +
+    ps-lite rendezvous, kvstore_dist.h:51-53)."""
+    import jax
+    if _initialized[0] or jax.process_count() > 1:
+        _initialized[0] = True
+        return
+    coordinator = coordinator or os.environ.get("COORDINATOR_ADDRESS")
+    if coordinator is None:
+        # single-process: nothing to bootstrap
+        _initialized[0] = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=int(num_processes or
+                          os.environ.get("NUM_PROCESSES", 1)),
+        process_id=int(process_id or os.environ.get("PROCESS_ID", 0)))
+    _initialized[0] = True
+
+
+def barrier():
+    """Global barrier (reference: ps Barrier, kvstore_dist.h:108)."""
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("mxnet_tpu_barrier")
+
+
+def allreduce(array):
+    """Sum an array across all processes (returns the global sum)."""
+    import jax
+    import jax.numpy as jnp
+    if jax.process_count() == 1:
+        return array
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(np.asarray(array))
+    return jnp.asarray(np.sum(gathered, axis=0))
+
+
+class DistKVStore(KVStore):
+    """dist_sync / dist_device_sync / dist_async kvstore types.
+
+    Push sums gradients across every process (the reference's server-side
+    merge across NumWorkers() pushes, kvstore_dist_server.h:189); pull
+    returns the merged value or the optimizer-updated weight.
+    """
+
+    def __init__(self, kv_type="dist_sync"):
+        super().__init__(kv_type)
+        init()
+        if kv_type == "dist_async":
+            import warnings
+            warnings.warn(
+                "dist_async is emulated as synchronous data parallelism on "
+                "TPU (XLA collectives are cooperative); convergence "
+                "semantics match dist_sync")
+
+    @property
+    def is_distributed(self):
+        return True
+
+    def push(self, key, value, priority=0):
+        keys, values = [key], [value]
+        if isinstance(key, (list, tuple)):
+            keys, values = list(key), list(value)
+        for k, v in zip(keys, values):
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            agg = vals[0]
+            for extra in vals[1:]:
+                agg = agg + extra
+            # cross-process reduction (≙ server merge)
+            agg = _wrap(allreduce(agg._data))
+            if self._updater is not None:
+                if k not in self._data:
+                    raise ValueError(f"key {k} not initialized")
+                self._updater(_key_int(k), agg, self._data[k])
+            else:
+                self._merged = getattr(self, "_merged", {})
+                self._merged[k] = agg
+
+
+def _key_int(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k

@@ -1,0 +1,60 @@
+"""Device mesh management.
+
+The mesh is the TPU-native "cluster": axes name parallelism dimensions
+(data/model/pipeline/seq/expert). The reference's notion of "device group"
+(ctx lists in Module, kvstore device lists) maps to mesh axes.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "current_mesh", "set_default_mesh", "replicated",
+           "batch_sharded", "P", "NamedSharding"]
+
+_default_mesh = [None]
+
+
+def make_mesh(axes: Optional[dict] = None, devices=None) -> Mesh:
+    """Create a Mesh from {axis_name: size}.
+
+    ``make_mesh({'data': 8})`` or ``make_mesh({'data': 4, 'model': 2})``.
+    Sizes may use -1 once to absorb the remaining devices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if not axes:
+        axes = {"data": len(devices)}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} "
+                         f"devices, only {len(devices)} available")
+    arr = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def set_default_mesh(mesh: Optional[Mesh]):
+    _default_mesh[0] = mesh
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _default_mesh[0]
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = "data", ndim: int = 2,
+                  batch_dim: int = 0) -> NamedSharding:
+    spec = [None] * ndim
+    spec[batch_dim] = axis
+    return NamedSharding(mesh, P(*spec))

@@ -1,0 +1,275 @@
+"""Fused, sharded training step.
+
+This is the TPU-native replacement for the reference's steady-state hot loop
+(SURVEY.md §3.2): GraphExecutor::RunOps pushing cached per-op engine
+operations + KVStore push/pull per layer. Here the ENTIRE training step —
+forward, backward, gradient reduction across the mesh, optimizer update, and
+BatchNorm running-stat fold — is one XLA computation: compiled once, fully
+fused, with parameter/optimizer buffers donated (zero-copy in-place update)
+and cross-chip gradient reductions (psum) inserted by GSPMD exactly where
+the dataflow needs them, overlapping backward compute the way the
+reference's priority-ordered engine pushes did (trainer.py:190 priority=-i).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ndarray.ndarray import NDArray, _wrap
+
+__all__ = ["TrainStep", "softmax_ce_loss", "l2_loss"]
+
+
+def softmax_ce_loss(logits, labels):
+    """Mean softmax cross entropy with integer labels (the train_imagenet
+    objective; reference op: SoftmaxOutput src/operator/softmax_output.cc)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(
+        logp, labels.astype(jnp.int32)[:, None], axis=-1)
+    return -jnp.mean(picked)
+
+
+def l2_loss(pred, target):
+    return 0.5 * jnp.mean(jnp.square(pred - target.reshape(pred.shape)))
+
+
+_LOSSES = {"softmax_ce": softmax_ce_loss, "l2": l2_loss}
+
+
+# -- functional optimizers ---------------------------------------------------
+# The in-step analog of mxnet_tpu.optimizer: pure (param, grad, state) ->
+# (param, state) rules reusing the registered update ops' math.
+
+def _sgd_init(p):
+    return ()
+
+
+def _sgd_update(p, g, s, lr, momentum=0.0, wd=0.0):
+    g = g.astype(jnp.float32) + wd * p
+    if momentum:
+        (mom,) = s
+        mom = momentum * mom - lr * g
+        return p + mom, (mom,)
+    return p - lr * g, ()
+
+
+def _sgd_mom_init(p):
+    return (jnp.zeros_like(p),)
+
+
+def _adam_init(p):
+    return (jnp.zeros_like(p), jnp.zeros_like(p), jnp.zeros((), jnp.int32))
+
+
+def _adam_update(p, g, s, lr, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0):
+    mean, var, t = s
+    t = t + 1
+    g = g.astype(jnp.float32) + wd * p
+    mean = beta1 * mean + (1 - beta1) * g
+    var = beta2 * var + (1 - beta2) * jnp.square(g)
+    tf = t.astype(jnp.float32)
+    lr_t = lr * jnp.sqrt(1 - beta2 ** tf) / (1 - beta1 ** tf)
+    return p - lr_t * mean / (jnp.sqrt(var) + epsilon), (mean, var, t)
+
+
+def _lars_update(p, g, s, lr, momentum=0.9, wd=0.0, eta=0.001):
+    """LARS layer-wise adaptive rate (reference: LBSGD optimizer.py:648) —
+    the large-batch recipe that the high-MFU regime needs."""
+    (mom,) = s
+    g = g.astype(jnp.float32)
+    w_norm = jnp.linalg.norm(p)
+    g_norm = jnp.linalg.norm(g)
+    trust = jnp.where((w_norm > 0) & (g_norm > 0),
+                      eta * w_norm / (g_norm + wd * w_norm + 1e-9), 1.0)
+    g = trust * (g + wd * p)
+    mom = momentum * mom + lr * g
+    return p - mom, (mom,)
+
+
+_OPTS = {
+    "sgd": (lambda kw: _sgd_mom_init if kw.get("momentum") else _sgd_init,
+            _sgd_update),
+    "adam": (lambda kw: _adam_init, _adam_update),
+    "lars": (lambda kw: _sgd_mom_init, _lars_update),
+}
+
+
+class TrainStep:
+    """One-XLA-computation training step for a HybridBlock.
+
+    Usage::
+
+        step = TrainStep(net, loss="softmax_ce", optimizer="sgd",
+                         optimizer_params={"momentum": 0.9}, mesh=mesh)
+        loss = step(x, y)          # NDArray/ndarray in, scalar out
+
+    With a mesh, the batch is sharded over the 'data' axis and parameters
+    are replicated (data parallelism); pass ``param_spec_fn`` for
+    tensor-parallel parameter layouts.
+    """
+
+    def __init__(self, net, loss="softmax_ce", optimizer="sgd",
+                 optimizer_params=None, mesh: Optional[Mesh] = None,
+                 data_axis="data", compute_dtype=None, lr=0.01,
+                 lr_schedule: Optional[Callable[[int], float]] = None,
+                 param_spec_fn=None):
+        self.net = net
+        self.loss_fn = _LOSSES[loss] if isinstance(loss, str) else loss
+        optimizer_params = dict(optimizer_params or {})
+        self.lr = optimizer_params.pop("learning_rate", lr)
+        self.lr_schedule = lr_schedule
+        init_f, update_f = _OPTS[optimizer]
+        self._opt_init = init_f(optimizer_params)
+        self._opt_update = functools.partial(update_f, **optimizer_params)
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.compute_dtype = compute_dtype
+        self._num_update = 0
+
+        self.param_list = net._get_param_list()
+        self._trainable = [p.grad_req != "null" for p in self.param_list]
+        # staged forward in training mode: fn(pvals, args, key)->(outs,writes)
+        _, self._staged = net._build_jit(training=True)
+        self._pvals = None
+        self._opt_state = None
+        self._step_jit = None
+        self._param_spec_fn = param_spec_fn
+
+    # -- state ----------------------------------------------------------------
+    def _init_state(self):
+        pvals = tuple(p.data()._data for p in self.param_list)
+        opt_state = tuple(
+            self._opt_init(v) if t else ()
+            for v, t in zip(pvals, self._trainable))
+        if self.mesh is not None:
+            rep = NamedSharding(self.mesh, P())
+            if self._param_spec_fn is not None:
+                shard = [NamedSharding(self.mesh,
+                                       self._param_spec_fn(p) or P())
+                         for p in self.param_list]
+            else:
+                shard = [rep] * len(pvals)
+            pvals = tuple(jax.device_put(v, s)
+                          for v, s in zip(pvals, shard))
+            opt_state = tuple(
+                tuple(jax.device_put(x, s) if hasattr(x, "shape") else x
+                      for x in st)
+                for st, s in zip(opt_state, shard))
+        self._pvals = pvals
+        self._opt_state = opt_state
+
+    def _build_step(self):
+        staged = self._staged
+        loss_fn = self.loss_fn
+        opt_update = self._opt_update
+        trainable = self._trainable
+        compute_dtype = self.compute_dtype
+        param_objs = self.param_list
+
+        def step_fn(pvals, opt_state, x, y, key, lr):
+            def fwd(pv):
+                pv_c = pv
+                if compute_dtype is not None:
+                    pv_c = tuple(
+                        v.astype(compute_dtype)
+                        if v.dtype == jnp.float32 else v for v in pv)
+                    x_c = x.astype(compute_dtype) \
+                        if x.dtype == jnp.float32 else x
+                else:
+                    x_c = x
+                outs, writes = staged(pv_c, (x_c,), key)
+                return loss_fn(outs[0], y), writes
+
+            (loss, writes), grads = jax.value_and_grad(
+                fwd, has_aux=True)(pvals)
+            # optimizer update on trainable params only
+            new_p, new_s = [], []
+            for p, g, s, t in zip(pvals, grads, opt_state, trainable):
+                if t:
+                    np_, ns_ = opt_update(p, g, s, lr)
+                    new_p.append(np_.astype(p.dtype))
+                    new_s.append(ns_)
+                else:
+                    new_p.append(p)
+                    new_s.append(s)
+            # fold BatchNorm running-stat writes (identified at trace time)
+            write_params = getattr(staged, "_write_params", [])
+            if write_params:
+                idx = {id(p): i for i, p in enumerate(param_objs)}
+                for wp, wv in zip(write_params, writes):
+                    i = idx.get(id(wp))
+                    if i is not None:
+                        new_p[i] = wv.astype(new_p[i].dtype)
+            return tuple(new_p), tuple(new_s), loss
+
+        donate = (0, 1)
+        if self.mesh is not None:
+            rep = NamedSharding(self.mesh, P())
+            batch1 = NamedSharding(self.mesh, P(self.data_axis))
+            # param shardings mirror _init_state
+            if self._param_spec_fn is not None:
+                pshard = tuple(NamedSharding(self.mesh,
+                                             self._param_spec_fn(p) or P())
+                               for p in self.param_list)
+            else:
+                pshard = tuple(rep for _ in self.param_list)
+            sshard = tuple(
+                tuple(ps for _ in st) if st else ()
+                for ps, st in zip(pshard, self._opt_state))
+            in_shardings = (pshard, sshard, batch1, batch1, rep, rep)
+            self._step_jit = jax.jit(step_fn, donate_argnums=donate,
+                                     in_shardings=in_shardings)
+        else:
+            self._step_jit = jax.jit(step_fn, donate_argnums=donate)
+
+    # -- public ---------------------------------------------------------------
+    def __call__(self, x, y):
+        if self._pvals is None:
+            # ensure deferred params are materialized (one eager fwd if needed)
+            try:
+                for p in self.param_list:
+                    p._check_and_get()
+            except Exception:
+                import numpy as _np
+                from .. import autograd as _ag
+                xa = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+                with _ag.train_mode():
+                    self.net.forward(_wrap(xa[:1]))
+                self.param_list = self.net._get_param_list()
+                self._trainable = [p.grad_req != "null"
+                                   for p in self.param_list]
+            self._init_state()
+        if self._step_jit is None:
+            self._build_step()
+        from .. import random as _random
+        xa = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        ya = y._data if isinstance(y, NDArray) else jnp.asarray(y)
+        if self.mesh is not None:
+            batch = NamedSharding(self.mesh, P(self.data_axis))
+            xa = jax.device_put(xa, batch)
+            ya = jax.device_put(ya, batch)
+        lr = self.lr if self.lr_schedule is None \
+            else self.lr_schedule(self._num_update)
+        self._pvals, self._opt_state, loss = self._step_jit(
+            self._pvals, self._opt_state, xa, ya, _random.next_key(),
+            jnp.asarray(lr, jnp.float32))
+        self._num_update += 1
+        return _wrap(loss)
+
+    def sync_params(self):
+        """Write the step's parameter buffers back into the net's Parameters
+        (they live donated inside the step between calls)."""
+        if self._pvals is None:
+            return
+        for p, v in zip(self.param_list, self._pvals):
+            p._check_and_get()._data = v
+
+    @property
+    def num_update(self):
+        return self._num_update

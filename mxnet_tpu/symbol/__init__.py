@@ -1,0 +1,110 @@
+"""``mxnet_tpu.sym`` — symbolic op namespace.
+
+Like the reference, every registered op is exposed as a symbol-building
+function (reference: python/mxnet/symbol/register.py codegen); missing
+weight-like inputs auto-create variables named ``{name}_{input}``
+(reference composition semantics, symbol.py:56 compose).
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from ..name import NameManager
+from ..ops.registry import _OPS
+from .op_info import op_input_names
+from .symbol import (Symbol, var, Variable, Group, load, load_json, _Node)
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
+
+
+def _symbol_op(op_name, sym_inputs, attrs, name=None, attr=None):
+    """Create an op node from symbol inputs + attrs."""
+    opdef = _OPS[op_name]
+    num_outputs = opdef.num_outputs if opdef.num_outputs > 0 else 1
+    name = NameManager.current.get(name, op_name.lower())
+    node = _Node(op_name, name, attrs=attrs,
+                 inputs=[(s._node, s._out_index) for s in sym_inputs],
+                 num_outputs=num_outputs, user_attrs=attr)
+    from ..attribute import current_attrs
+    scope_attrs = current_attrs()
+    if scope_attrs:
+        node.user_attrs.update(scope_attrs)
+    return Symbol(node)
+
+
+def _make_sym_func(opdef):
+    arg_names, aux_names = op_input_names(opdef.name)
+
+    def fn(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        attr = kwargs.pop("attr", None)
+        sym_inputs = []
+        # positional symbols
+        pos = [a for a in args if isinstance(a, Symbol)]
+        non_sym = [a for a in args if not isinstance(a, Symbol)]
+        if non_sym and arg_names is None:
+            pass  # variadic ops take only symbols positionally
+        if arg_names is not None:
+            # named-input protocol: collect from kwargs by input name, then
+            # positionally; auto-create missing trailing weight inputs
+            resolved = {}
+            for n in arg_names + aux_names:
+                if n in kwargs and isinstance(kwargs[n], Symbol):
+                    resolved[n] = kwargs.pop(n)
+            it = iter(pos)
+            for n in arg_names + aux_names:
+                if n not in resolved:
+                    try:
+                        resolved[n] = next(it)
+                    except StopIteration:
+                        break
+            opname = NameManager.current.get(name, opdef.name.lower())
+            no_bias = kwargs.get("no_bias", False)
+            full = []
+            for n in arg_names + aux_names:
+                if n in resolved:
+                    full.append((n, resolved[n]))
+                elif n == "bias" and no_bias:
+                    continue
+                elif n in ("data", "lhs", "rhs", "indices", "index",
+                           "a", "condition", "x", "y", "rois", "grid", "loc",
+                           "sequence_length", "data_lengths",
+                           "label_lengths", "state_cell"):
+                    continue  # data-like inputs are never auto-created
+                # NB: 'label' IS auto-created ({name}_label), matching the
+                # reference's softmax_label convention
+                else:
+                    v = var(f"{opname}_{n}")
+                    if n in aux_names:
+                        v._node.attrs["__is_aux__"] = True
+                    full.append((n, v))
+            sym_inputs = [s for _, s in full]
+            return _symbol_op(opdef.name, sym_inputs,
+                              {k: v for k, v in kwargs.items()
+                               if v is not None},
+                              name=opname, attr=attr)
+        # variadic / positional ops
+        sym_inputs = pos
+        return _symbol_op(opdef.name, sym_inputs,
+                          {k: v for k, v in kwargs.items() if v is not None},
+                          name=name, attr=attr)
+
+    fn.__name__ = opdef.name
+    fn.__doc__ = opdef.fn.__doc__
+    return fn
+
+
+_mod = sys.modules[__name__]
+for _name in list(_OPS):
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make_sym_func(_OPS[_name]))
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    return getattr(_mod, "_zeros")(shape=shape, dtype=dtype, **kwargs)
+
+
+def ones(shape, dtype="float32", **kwargs):
+    return getattr(_mod, "_ones")(shape=shape, dtype=dtype, **kwargs)

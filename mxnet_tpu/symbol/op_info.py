@@ -1,0 +1,88 @@
+"""Per-op input signatures for the symbolic layer.
+
+The reference stores each op's named inputs in the NNVM registry
+(FListInputNames); bindings query MXSymbolGetAtomicSymbolInfo. Here the
+table lists (input names, aux input names) for stateful/layer ops; ops not
+listed take positional tensor inputs. Aux inputs (BatchNorm moving stats)
+are the reference's "auxiliary states" (ndarray.h aux_states): inputs that
+are not arguments and receive no gradient.
+"""
+
+# op name -> (arg input names, aux input names)
+OP_INPUTS = {
+    "FullyConnected": (["data", "weight", "bias"], []),
+    "Convolution": (["data", "weight", "bias"], []),
+    "Deconvolution": (["data", "weight", "bias"], []),
+    "BatchNorm": (["data", "gamma", "beta"], ["moving_mean", "moving_var"]),
+    "BatchNorm_v1": (["data", "gamma", "beta"],
+                     ["moving_mean", "moving_var"]),
+    "LayerNorm": (["data", "gamma", "beta"], []),
+    "InstanceNorm": (["data", "gamma", "beta"], []),
+    "Embedding": (["data", "weight"], []),
+    "RNN": (["data", "parameters", "state", "state_cell"], []),
+    "SoftmaxOutput": (["data", "label"], []),
+    "Softmax": (["data", "label"], []),
+    "LinearRegressionOutput": (["data", "label"], []),
+    "LogisticRegressionOutput": (["data", "label"], []),
+    "MAERegressionOutput": (["data", "label"], []),
+    "softmax_cross_entropy": (["data", "label"], []),
+    "SVMOutput": (["data", "label"], []),
+    "Activation": (["data"], []),
+    "LeakyReLU": (["data", "gamma"], []),
+    "Pooling": (["data"], []),
+    "Pooling_v1": (["data"], []),
+    "Dropout": (["data"], []),
+    "Flatten": (["data"], []),
+    "Reshape": (["data"], []),
+    "Concat": (None, []),  # variadic
+    "add_n": (None, []),
+    "ElementWiseSum": (None, []),
+    "SliceChannel": (["data"], []),
+    "Crop": (None, []),
+    "UpSampling": (None, []),
+    "dot": (["lhs", "rhs"], []),
+    "batch_dot": (["lhs", "rhs"], []),
+    "broadcast_add": (["lhs", "rhs"], []),
+    "broadcast_sub": (["lhs", "rhs"], []),
+    "broadcast_mul": (["lhs", "rhs"], []),
+    "broadcast_div": (["lhs", "rhs"], []),
+    "elemwise_add": (["lhs", "rhs"], []),
+    "elemwise_sub": (["lhs", "rhs"], []),
+    "elemwise_mul": (["lhs", "rhs"], []),
+    "elemwise_div": (["lhs", "rhs"], []),
+    "CTCLoss": (["data", "label", "data_lengths", "label_lengths"], []),
+    "SequenceMask": (["data", "sequence_length"], []),
+    "SequenceLast": (["data", "sequence_length"], []),
+    "SequenceReverse": (["data", "sequence_length"], []),
+    "ROIPooling": (["data", "rois"], []),
+    "BilinearSampler": (["data", "grid"], []),
+    "SpatialTransformer": (["data", "loc"], []),
+    "GridGenerator": (["data"], []),
+    "L2Normalization": (["data"], []),
+    "LRN": (["data"], []),
+    "Custom": (None, []),
+    "where": (["condition", "x", "y"], []),
+    "Cast": (["data"], []),
+    "BlockGrad": (["data"], []),
+    "MakeLoss": (["data"], []),
+    "slice": (["data"], []),
+    "take": (["a", "indices"], []),
+    "one_hot": (["indices"], []),
+    "pick": (["data", "index"], []),
+    "gather_nd": (["data", "indices"], []),
+    "scatter_nd": (["data", "indices"], []),
+}
+
+# ops whose extra weight-like inputs default-initialize when unspecified:
+# suffix -> initializer hint matched by initializer.Initializer.__call__
+DEFAULT_INIT_HINT = {
+    "weight": "weight", "bias": "bias", "gamma": "gamma", "beta": "beta",
+    "moving_mean": "moving_mean", "moving_var": "moving_var",
+}
+
+
+def op_input_names(op_name, n_positional=None):
+    """(arg_names, aux_names) for an op; None arg_names means variadic."""
+    if op_name in OP_INPUTS:
+        return OP_INPUTS[op_name]
+    return None, []

@@ -1,0 +1,56 @@
+"""Model zoo tests (reference model: tests/python/unittest/test_gluon_model_zoo.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+@pytest.mark.parametrize("name,size", [
+    ("resnet18_v1", 32), ("resnet34_v1", 32), ("resnet18_v2", 32),
+    ("mobilenet0.25", 32), ("mobilenetv2_0.25", 32),
+    ("squeezenet1.1", 224),
+])
+def test_models_forward(name, size):
+    net = vision.get_model(name, classes=7)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).randn(2, 3, size, size)
+                    .astype(np.float32))
+    out = net(x)
+    assert out.shape == (2, 7)
+
+
+def test_resnet50_structure():
+    """Bottleneck ResNet-50 has the canonical ~25.5M params at 1000 classes."""
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize()
+    x = mx.nd.zeros((1, 3, 224, 224))
+    out = net(x)
+    assert out.shape == (1, 1000)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in net.collect_params().values())
+    assert 25.4e6 < n_params < 25.8e6, n_params
+
+
+def test_model_zoo_train_step():
+    net = vision.get_model("resnet18_v1", classes=4)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).randn(8, 3, 32, 32)
+                    .astype(np.float32))
+    y = mx.nd.array(np.array([0, 1, 2, 3] * 2, np.float32))
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 0.005})
+    losses = []
+    for _ in range(8):
+        with mx.autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(8)
+        losses.append(float(loss.mean().asscalar()))
+    assert min(losses[-3:]) < losses[0], losses
+
+
+def test_get_model_unknown():
+    with pytest.raises(ValueError):
+        vision.get_model("resnet9000")

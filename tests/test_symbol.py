@@ -1,0 +1,123 @@
+"""Symbol tests (reference model: tests/python/unittest/test_symbol.py,
+test_infer_shape.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(data=fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def test_compose_and_arguments():
+    out = _mlp()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    out = _mlp()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(8, 10))
+    assert arg_shapes[1] == (16, 10)  # fc1_weight
+    assert arg_shapes[3] == (4, 16)   # fc2_weight
+    assert out_shapes == [(8, 4)]
+
+
+def test_infer_shape_conv_bn():
+    data = mx.sym.var("data")
+    conv = mx.sym.Convolution(data=data, num_filter=8, kernel=(3, 3),
+                              pad=(1, 1), name="conv1")
+    bn = mx.sym.BatchNorm(data=conv, name="bn1")
+    arg_shapes, out_shapes, aux_shapes = bn.infer_shape(data=(2, 3, 8, 8))
+    args = bn.list_arguments()
+    shapes = dict(zip(args, arg_shapes))
+    assert shapes["conv1_weight"] == (8, 3, 3, 3)
+    assert shapes["bn1_gamma"] == (8,)
+    assert aux_shapes == [(8,), (8,)]
+    assert bn.list_auxiliary_states() == ["bn1_moving_mean",
+                                          "bn1_moving_var"]
+
+
+def test_symbol_arithmetic_eval():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = 2.0 * a + b / 2.0 - 1.0
+    ex = c.bind(mx.cpu(), {"a": mx.nd.array([1.0, 2.0]),
+                           "b": mx.nd.array([4.0, 8.0])}, grad_req="null")
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, [3.0, 7.0])
+
+
+def test_executor_backward_softmax_semantics():
+    """SoftmaxOutput backward must equal p - onehot(y) per sample
+    (reference: src/operator/softmax_output.cc)."""
+    data = mx.sym.var("data")
+    out = mx.sym.SoftmaxOutput(data=data, name="softmax")
+    x = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    y = np.array([0, 1, 2, 0], np.float32)
+    ex = out.bind(mx.cpu(),
+                  {"data": mx.nd.array(x),
+                   "softmax_label": mx.nd.array(y)},
+                  args_grad={"data": mx.nd.zeros((4, 3))})
+    ex.forward(is_train=True)
+    ex.backward()
+    p = np.exp(x) / np.exp(x).sum(1, keepdims=True)
+    onehot = np.eye(3, dtype=np.float32)[y.astype(int)]
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), p - onehot,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_json_roundtrip():
+    out = _mlp()
+    js = out.tojson()
+    out2 = mx.sym.load_json(js)
+    assert out2.list_arguments() == out.list_arguments()
+    assert out2.list_outputs() == out.list_outputs()
+    # same numeric result
+    shapes = {"data": (2, 6), "softmax_label": (2,)}
+    ex1 = out.simple_bind(mx.cpu(), **shapes)
+    rng = np.random.RandomState(0)
+    for n in ex1.arg_dict:
+        ex1.arg_dict[n][:] = rng.randn(*ex1.arg_dict[n].shape)\
+            .astype(np.float32)
+    ex2 = out2.bind(mx.cpu(), dict(ex1.arg_dict), grad_req="null")
+    o1 = ex1.forward()[0].asnumpy()
+    o2 = ex2.forward()[0].asnumpy()
+    np.testing.assert_allclose(o1, o2, rtol=1e-6)
+
+
+def test_group_and_internals():
+    a = mx.sym.var("a")
+    b = a * 2
+    c = a + 1
+    g = mx.sym.Group([b, c])
+    assert len(g) == 2
+    ex = g.bind(mx.cpu(), {"a": mx.nd.array([1.0])}, grad_req="null")
+    outs = ex.forward()
+    assert float(outs[0].asnumpy()[0]) == 2.0
+    assert float(outs[1].asnumpy()[0]) == 2.0
+    internals = b.get_internals()
+    assert any("a" == s.name for s in internals)
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        w = mx.sym.var("w")
+        y = mx.sym.FullyConnected(data=w, num_hidden=3, name="fc")
+    assert y.attr("__ctx_group__") == "dev1"
+
+
+def test_executor_reshape():
+    out = _mlp()
+    ex = out.simple_bind(mx.cpu(), data=(8, 10), softmax_label=(8,))
+    ex2 = ex.reshape(data=(16, 10), softmax_label=(16,))
+    assert ex2.arg_dict["data"].shape == (16, 10)
+    # weights shared (same shape → same arrays)
+    assert ex2.arg_dict["fc1_weight"] is ex.arg_dict["fc1_weight"]
