@@ -1,0 +1,126 @@
+"""Train an RNN language model
+(reference: example/gluon/word_language_model/train.py).
+
+With no dataset available (no network egress), --synthetic generates a
+Markov-chain corpus so the script runs end-to-end; point --data at a
+tokenized text file for real use.
+"""
+import argparse
+import math
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+from model import RNNModel
+
+parser = argparse.ArgumentParser(description="Gluon word language model")
+parser.add_argument("--data", type=str, default=None,
+                    help="path to a whitespace-tokenized text file")
+parser.add_argument("--model", type=str, default="lstm")
+parser.add_argument("--emsize", type=int, default=200)
+parser.add_argument("--nhid", type=int, default=200)
+parser.add_argument("--nlayers", type=int, default=2)
+parser.add_argument("--lr", type=float, default=1.0)
+parser.add_argument("--clip", type=float, default=0.2)
+parser.add_argument("--epochs", type=int, default=3)
+parser.add_argument("--batch_size", type=int, default=32)
+parser.add_argument("--bptt", type=int, default=35)
+parser.add_argument("--dropout", type=float, default=0.2)
+parser.add_argument("--tied", action="store_true")
+parser.add_argument("--synthetic", action="store_true", default=True)
+parser.add_argument("--vocab", type=int, default=500)
+args = parser.parse_args()
+
+
+def make_corpus():
+    if args.data:
+        with open(args.data) as f:
+            tokens = f.read().split()
+        vocab = {w: i for i, w in enumerate(sorted(set(tokens)))}
+        return np.array([vocab[w] for w in tokens], np.int32), len(vocab)
+    rng = np.random.RandomState(0)
+    trans = rng.dirichlet(np.ones(args.vocab) * 0.05, size=args.vocab)
+    corpus = np.zeros(120000, np.int32)
+    state = 0
+    for i in range(len(corpus)):
+        state = rng.choice(args.vocab, p=trans[state])
+        corpus[i] = state
+    return corpus, args.vocab
+
+
+def batchify(data, batch_size):
+    nbatch = len(data) // batch_size
+    return data[:nbatch * batch_size].reshape(batch_size, nbatch).T
+
+
+def get_batch(source, i):
+    seq_len = min(args.bptt, source.shape[0] - 1 - i)
+    data = source[i:i + seq_len]
+    target = source[i + 1:i + 1 + seq_len]
+    return mx.nd.array(data), mx.nd.array(target.reshape(-1))
+
+
+def detach(hidden):
+    return [h.detach() for h in hidden] if isinstance(hidden, list) \
+        else hidden.detach()
+
+
+def evaluate(model, source, loss_fn):
+    total_loss, ntotal = 0.0, 0
+    hidden = model.begin_state(batch_size=args.batch_size)
+    for i in range(0, source.shape[0] - 1, args.bptt):
+        data, target = get_batch(source, i)
+        output, hidden = model(data, hidden)
+        loss = loss_fn(output, target)
+        total_loss += float(loss.mean().asscalar()) * len(target)
+        ntotal += len(target)
+    return total_loss / ntotal
+
+
+def main():
+    corpus, vocab_size = make_corpus()
+    n = len(corpus)
+    train_data = batchify(corpus[:int(n * 0.9)], args.batch_size)
+    val_data = batchify(corpus[int(n * 0.9):], args.batch_size)
+
+    model = RNNModel(args.model, vocab_size, args.emsize, args.nhid,
+                     args.nlayers, args.dropout, args.tied)
+    model.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0,
+                             "wd": 0})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        total_loss, ntokens = 0.0, 0
+        hidden = model.begin_state(batch_size=args.batch_size)
+        start = time.time()
+        for ibatch, i in enumerate(range(0, train_data.shape[0] - 1,
+                                         args.bptt)):
+            data, target = get_batch(train_data, i)
+            hidden = detach(hidden)
+            with mx.autograd.record():
+                output, hidden = model(data, hidden)
+                loss = loss_fn(output, target)
+            loss.backward()
+            grads = [p.grad() for p in model.collect_params().values()
+                     if p.grad_req != "null"]
+            gluon.utils.clip_global_norm(
+                grads, args.clip * len(target))
+            trainer.step(len(target))
+            total_loss += float(loss.mean().asscalar()) * len(target)
+            ntokens += len(target) * data.shape[1]
+            if ibatch % 20 == 0 and ibatch > 0:
+                cur = total_loss / (ibatch + 1) / len(target)
+                print(f"epoch {epoch} batch {ibatch} ppl "
+                      f"{math.exp(min(cur, 20)):.2f} "
+                      f"{ntokens / (time.time() - start):.0f} tok/s")
+        val_loss = evaluate(model, val_data, loss_fn)
+        print(f"epoch {epoch}: val ppl {math.exp(min(val_loss, 20)):.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -45,5 +45,6 @@ from . import model
 from . import module
 from . import module as mod
 from . import gluon
+from . import rnn
 from . import parallel
 from .io import DataBatch, DataIter

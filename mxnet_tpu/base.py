@@ -100,3 +100,10 @@ def check_call(ret):  # pragma: no cover - compat shim
     """Compat shim: the reference checks C-API return codes (base.py:214);
     there is no C ABI here, so this is a no-op kept for API parity."""
     return ret
+
+
+def as_list(obj):
+    """Coerce to list (shared helper; reference: base.py _as_list usages)."""
+    if isinstance(obj, (list, tuple)):
+        return list(obj)
+    return [obj]

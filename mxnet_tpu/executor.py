@@ -99,12 +99,14 @@ class Executor:
         arg_names = self.arg_names
         aux_names = self.aux_names
 
-        def fwd(arg_vals, aux_vals):
+        def fwd(arg_vals, aux_vals, key, training):
             amap = dict(zip(arg_names, arg_vals))
             amap.update(zip(aux_names, aux_vals))
-            return tuple(sym.eval_arrays(amap))
+            outs, aux_updates = sym.eval_arrays_ex(amap, training=training,
+                                                   rng_key=key)
+            return tuple(outs), aux_updates
 
-        self._fwd_jit = jax.jit(fwd)
+        self._fwd_jit = jax.jit(fwd, static_argnums=(3,))
 
         # implicit-loss backward: sum of per-head implicit losses + explicit
         # head-gradient path for other outputs
@@ -119,28 +121,31 @@ class Executor:
                 loss_specs.append((i, node, attrs))
         self._loss_specs = loss_specs
 
-        def fwd_loss(arg_vals, aux_vals, head_grads):
+        def fwd_loss(arg_vals, aux_vals, head_grads, key):
             """Returns scalar pseudo-loss whose grad wrt args is the
             backward of the graph with implicit losses + sum(out*head_grad)
             for explicit heads."""
             import jax.numpy as jnp
             amap = dict(zip(arg_names, arg_vals))
             amap.update(zip(aux_names, aux_vals))
-            outs = sym.eval_arrays(amap)
+            outs, aux_updates = sym.eval_arrays_ex(amap, training=True,
+                                                   rng_key=key)
             total = jnp.zeros((), jnp.float32)
             implicit = {i for i, _, _ in loss_specs}
             for i, node, attrs in loss_specs:
-                # recompute the loss from the head node's *inputs*
+                # recompute the loss from the head node's *inputs* (XLA CSE
+                # dedups against the forward eval)
                 ins = []
                 for p, oi in node.inputs:
                     sub = type(sym)(p, oi)
-                    ins.append(sub.eval_arrays(amap)[0])
+                    ins.append(sub.eval_arrays(amap, training=True,
+                                               rng_key=key)[0])
                 total = total + _IMPLICIT_LOSS[node.op](*ins, **attrs)
             for i, o in enumerate(outs):
                 if i not in implicit and head_grads is not None and \
                         head_grads[i] is not None:
                     total = total + jnp.sum(o * head_grads[i])
-            return total, tuple(outs)
+            return total, (tuple(outs), aux_updates)
 
         self._fwd_loss_grad = jax.jit(jax.grad(fwd_loss, argnums=0,
                                                has_aux=True))
@@ -160,20 +165,31 @@ class Executor:
         if self._fwd_jit is None:
             self._build()
         self._is_train = is_train
+        from . import random as _random
         arg_vals = tuple(self.arg_dict[n]._data for n in self.arg_names)
         aux_vals = tuple(self.aux_dict[n]._data for n in self.aux_names)
-        outs = self._fwd_jit(arg_vals, aux_vals)
+        outs, aux_updates = self._fwd_jit(arg_vals, aux_vals,
+                                          _random.next_key(), bool(is_train))
         self.outputs = [_wrap(o) for o in outs]
+        self._apply_aux_updates(aux_updates)
         if self._monitor_callback is not None:
             for name, o in zip(self.output_names, self.outputs):
                 self._monitor_callback(name, o)
         return self.outputs
+
+    def _apply_aux_updates(self, aux_updates):
+        """Fold BatchNorm running-stat updates into aux arrays (functional
+        analog of the reference's in-place aux mutation)."""
+        for name, val in (aux_updates or {}).items():
+            if name in self.aux_dict:
+                self.aux_dict[name]._data = val
 
     def backward(self, out_grads=None, is_train=True):
         """(reference: executor.py:154; grads accumulate per grad_req)"""
         if self._fwd_jit is None:
             self._build()
         import jax.numpy as jnp
+        from . import random as _random
         arg_vals = tuple(self.arg_dict[n]._data for n in self.arg_names)
         aux_vals = tuple(self.aux_dict[n]._data for n in self.aux_names)
         if out_grads is None:
@@ -184,8 +200,10 @@ class Executor:
             head_grads = tuple(
                 g._data if isinstance(g, NDArray) else jnp.asarray(g)
                 for g in out_grads)
-        grads, outs = self._fwd_loss_grad(arg_vals, aux_vals, head_grads)
+        grads, (outs, aux_updates) = self._fwd_loss_grad(
+            arg_vals, aux_vals, head_grads, _random.next_key())
         self.outputs = [_wrap(o) for o in outs]
+        self._apply_aux_updates(aux_updates)
         for name, g in zip(self.arg_names, grads):
             req = self.grad_req.get(name, "null")
             if req == "null" or name not in self.grad_dict:

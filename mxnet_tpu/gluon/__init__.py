@@ -9,4 +9,5 @@ from . import loss
 from . import trainer
 from .trainer import Trainer
 from . import utils
+from . import rnn
 from . import model_zoo

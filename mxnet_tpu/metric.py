@@ -13,7 +13,7 @@ import math
 
 import numpy
 
-from .base import MXNetError
+from .base import MXNetError, as_list as _as_list
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "F1", "MCC", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
@@ -629,8 +629,3 @@ def np_metric(name=None, allow_extra_outputs=False):
 # the reference exposes this decorator as mx.metric.np
 np = np_metric
 
-
-def _as_list(obj):
-    if isinstance(obj, (list, tuple)):
-        return list(obj)
-    return [obj]

@@ -14,7 +14,7 @@ import numpy as np
 
 from .. import metric as metric_mod
 from .. import io as io_mod
-from ..base import MXNetError
+from ..base import MXNetError, as_list as _as_list
 from ..model import BatchEndParam
 
 __all__ = ["BaseModule"]
@@ -311,8 +311,3 @@ class BaseModule:
     def output_shapes(self):
         raise NotImplementedError
 
-
-def _as_list(obj):
-    if isinstance(obj, (list, tuple)):
-        return obj
-    return [obj]

@@ -29,6 +29,17 @@ from .base_module import BaseModule, _check_input_names
 __all__ = ["Module"]
 
 
+def _norm_shapes(shapes):
+    """Normalize [(name, shape)] / [DataDesc] to [(name, tuple)]."""
+    out = []
+    for s in shapes or []:
+        if isinstance(s, DataDesc):
+            out.append((s.name, tuple(s.shape)))
+        else:
+            out.append((s[0], tuple(s[1])))
+    return out
+
+
 class Module(BaseModule):
     """(reference: module.py:45)"""
 
@@ -235,18 +246,8 @@ class Module(BaseModule):
             return
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
-
-        def norm(shapes):
-            out = []
-            for s in shapes or []:
-                if isinstance(s, DataDesc):
-                    out.append((s.name, tuple(s.shape)))
-                else:
-                    out.append((s[0], tuple(s[1])))
-            return out
-
-        self._data_shapes = norm(data_shapes)
-        self._label_shapes = norm(label_shapes) if label_shapes else []
+        self._data_shapes = _norm_shapes(data_shapes)
+        self._label_shapes = _norm_shapes(label_shapes)
         shape_kwargs = dict(self._data_shapes + self._label_shapes)
         if not for_training:
             grad_req = "null"
@@ -388,16 +389,8 @@ class Module(BaseModule):
     def reshape(self, data_shapes, label_shapes=None):
         """(reference: module.py:448)"""
         assert self.binded
-        def norm(shapes):
-            out = []
-            for s in shapes or []:
-                if isinstance(s, DataDesc):
-                    out.append((s.name, tuple(s.shape)))
-                else:
-                    out.append((s[0], tuple(s[1])))
-            return out
-        self._data_shapes = norm(data_shapes)
-        self._label_shapes = norm(label_shapes) if label_shapes else []
+        self._data_shapes = _norm_shapes(data_shapes)
+        self._label_shapes = _norm_shapes(label_shapes)
         kwargs = dict(self._data_shapes + self._label_shapes)
         self._exec = self._exec.reshape(**kwargs)
         self._copy_params_to_exec()

@@ -31,7 +31,7 @@ from ..ops.registry import OpDef
 
 __all__ = ["NDArray", "array", "empty", "waitall", "_wrap"]
 
-_TRAINING_AWARE_OPS = {"Dropout", "BatchNorm"}
+_TRAINING_AWARE_OPS = {"Dropout", "BatchNorm", "RNN"}
 
 
 class NDArray:
@@ -522,6 +522,40 @@ def _invoke_fn(name, fn, nd_inputs, n_out=1):
     return out_nds[0] if len(out_nds) == 1 else tuple(out_nds)
 
 
+# Per-(op, attrs) jitted callables: keeps repeated eager calls on XLA's
+# compilation cache instead of re-tracing per call (the analog of the
+# reference's cached engine oprs, graph_executor.cc InitCachedOps). Ops with
+# internal RNG (Dropout) stay unjitted so each call draws a fresh key.
+_JIT_CACHE: dict = {}
+_UNJITTED_OPS = {"Dropout"}
+
+
+def _freeze_attr(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze_attr(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze_attr(x)) for k, x in v.items()))
+    return v
+
+
+def _get_op_callable(opdef, attrs):
+    if opdef.name in _UNJITTED_OPS or \
+            (opdef.name == "RNN" and attrs.get("p") and
+             attrs.get("training", True)):
+        # needs a fresh RNG key per call — jit would bake the key in
+        return functools.partial(_call_with_attrs, opdef, attrs)
+    try:
+        key = (opdef.name, _freeze_attr(attrs))
+        hash(key)
+    except TypeError:
+        return functools.partial(_call_with_attrs, opdef, attrs)
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(functools.partial(_call_with_attrs, opdef, dict(attrs)))
+        _JIT_CACHE[key] = fn
+    return fn
+
+
 def _invoke_op(name, nd_inputs, attrs):
     opdef = get_op(name)
     attrs = {k: v for k, v in attrs.items() if v is not None or k in ("axis", "axes", "a_min", "a_max")}
@@ -535,7 +569,7 @@ def _invoke_op(name, nd_inputs, attrs):
         result = tuple(_wrap(o) for o in outs)
         result = result[0] if len(result) == 1 else result
     else:
-        result = _invoke_fn(opdef.name, functools.partial(_call_with_attrs, opdef, attrs),
+        result = _invoke_fn(opdef.name, _get_op_callable(opdef, attrs),
                             [x if isinstance(x, NDArray) else _wrap(jnp.asarray(x))
                              for x in nd_inputs])
     if out is not None:

@@ -440,14 +440,19 @@ def _run_layer(xs, mode, wx, wh, bx, bh, h0, c0=None, reverse=False):
 def rnn(data, parameters, state, state_cell=None, state_size=None,
         num_layers=1, mode="lstm", bidirectional=False, p=0.0,
         state_outputs=False, lstm_state_clip_min=None, lstm_state_clip_max=None,
-        lstm_state_clip_nan=False, **kw):
+        lstm_state_clip_nan=False, training=None, key=None, **kw):
     """Fused RNN (reference: src/operator/rnn-inl.h, data layout (T, N, C);
     state (L*dirs, N, H)). Implemented as stacked ``lax.scan`` — the TPU-native
-    replacement of the cuDNN fused RNN kernel."""
+    replacement of the cuDNN fused RNN kernel. ``p`` applies dropout between
+    stacked layers in training mode (rnn-inl.h inter-layer dropout)."""
     T, N, C = data.shape
     dirs = 2 if bidirectional else 1
     layers = rnn_unpack_params(parameters, mode, num_layers, C, state_size,
                                bidirectional)
+    apply_dropout = p and p > 0.0 and (training is None or training)
+    if apply_dropout and key is None:
+        from ..random import next_key
+        key = next_key()
     xs = data
     h_out, c_out = [], []
     for layer in range(num_layers):
@@ -465,6 +470,11 @@ def rnn(data, parameters, state, state_cell=None, state_size=None,
             else:
                 h_out.append(carry[0])
         xs = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if apply_dropout and layer < num_layers - 1:
+            sub = jax.random.fold_in(key, layer)
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(sub, keep, xs.shape)
+            xs = xs * mask.astype(xs.dtype) / keep
     out = xs
     if state_outputs:
         hs = jnp.stack(h_out)

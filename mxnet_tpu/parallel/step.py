@@ -143,7 +143,11 @@ class TrainStep:
 
     # -- state ----------------------------------------------------------------
     def _init_state(self):
-        pvals = tuple(p.data()._data for p in self.param_list)
+        import jax.numpy as jnp
+        # copy the buffers: the step donates its param arrays, which would
+        # otherwise invalidate the net's live Parameter buffers
+        pvals = tuple(jnp.array(p.data()._data, copy=True)
+                      for p in self.param_list)
         opt_state = tuple(
             self._opt_init(v) if t else ()
             for v, t in zip(pvals, self._trainable))
@@ -264,11 +268,12 @@ class TrainStep:
 
     def sync_params(self):
         """Write the step's parameter buffers back into the net's Parameters
-        (they live donated inside the step between calls)."""
+        (copies — the step's own buffers get donated on the next call)."""
+        import jax.numpy as jnp
         if self._pvals is None:
             return
         for p, v in zip(self.param_list, self._pvals):
-            p._check_and_get()._data = v
+            p._check_and_get()._data = jnp.array(v, copy=True)
 
     @property
     def num_update(self):

@@ -1,0 +1,15 @@
+"""Legacy symbolic RNN namespace (reference: python/mxnet/rnn/).
+
+The cell zoo lives in ``mxnet_tpu.gluon.rnn`` (the reference's legacy
+symbolic cells map 1:1 onto the gluon cells; fused = gluon.rnn.LSTM). This
+namespace keeps the bucketing data iterator and aliases for scripts written
+against ``mx.rnn``.
+"""
+from .io import BucketSentenceIter
+from ..gluon.rnn import (RNNCell, LSTMCell, GRUCell, SequentialRNNCell,
+                         DropoutCell, ZoneoutCell, ResidualCell,
+                         BidirectionalCell)
+
+__all__ = ["BucketSentenceIter", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
+           "ResidualCell", "BidirectionalCell"]

@@ -19,10 +19,28 @@ from .symbol import (Symbol, var, Variable, Group, load, load_json, _Node)
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
 
 
+def _node_num_outputs(op_name, opdef, attrs):
+    """Resolve the output count for ops with attr-dependent arity
+    (registry num_outputs=-1): SliceChannel's num_outputs attr, RNN's
+    state_outputs (reference: each op's FNumOutputs/FNumVisibleOutputs)."""
+    if opdef.num_outputs > 0:
+        return opdef.num_outputs
+    if op_name == "SliceChannel":
+        return int(attrs.get("num_outputs", 1))
+    if op_name == "RNN":
+        if attrs.get("state_outputs"):
+            return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+        return 1
+    if op_name == "split_v2":
+        ind = attrs.get("indices")
+        return len(ind) + 1 if ind else 1
+    return 1
+
+
 def _symbol_op(op_name, sym_inputs, attrs, name=None, attr=None):
     """Create an op node from symbol inputs + attrs."""
     opdef = _OPS[op_name]
-    num_outputs = opdef.num_outputs if opdef.num_outputs > 0 else 1
+    num_outputs = _node_num_outputs(op_name, opdef, attrs)
     name = NameManager.current.get(name, op_name.lower())
     node = _Node(op_name, name, attrs=attrs,
                  inputs=[(s._node, s._out_index) for s in sym_inputs],

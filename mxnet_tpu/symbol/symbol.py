@@ -216,10 +216,26 @@ class Symbol:
     def _output_symbols(self):
         return list(self._group) if self._group is not None else [self]
 
-    def eval_arrays(self, arg_arrays: Dict[str, "np.ndarray"]):
+    def eval_arrays(self, arg_arrays: Dict[str, "np.ndarray"],
+                    training=False, rng_key=None):
         """Evaluate outputs given raw arrays for every variable."""
+        outs, _ = self.eval_arrays_ex(arg_arrays, training, rng_key)
+        return outs
+
+    def eval_arrays_ex(self, arg_arrays: Dict[str, "np.ndarray"],
+                      training=False, rng_key=None):
+        """Evaluate; returns (outputs, aux_updates).
+
+        ``training`` reaches training-aware ops (BatchNorm batch stats,
+        Dropout active); each stochastic node draws a key folded from
+        ``rng_key``. ``aux_updates`` maps aux var name → new value (BatchNorm
+        running stats), the functional form of the reference's in-place aux
+        mutation (batch_norm.cc)."""
+        import jax
         import jax.numpy as jnp
         cache: Dict[tuple, object] = {}
+        aux_updates: Dict[str, object] = {}
+        node_seq = {id(n): i for i, n in enumerate(self._topo_nodes())}
 
         def node_out(node, idx):
             key = (id(node), idx)
@@ -236,14 +252,31 @@ class Symbol:
             attrs = {k: parse_attr(v) for k, v in node.attrs.items()
                      if not k.startswith("__")}
             opdef = get_op(node.op)
+            if node.op in ("BatchNorm", "BatchNorm_v1", "Dropout", "RNN"):
+                attrs["training"] = training
+            if node.op in ("Dropout", "RNN") and training:
+                base = rng_key if rng_key is not None \
+                    else jax.random.PRNGKey(0)
+                attrs["key"] = jax.random.fold_in(base, node_seq[id(node)])
             res = opdef.fn(*ins, **attrs)
             outs = res if isinstance(res, tuple) else (res,)
             for i, o in enumerate(outs):
                 cache[(id(node), i)] = o
+            if training and node.op in ("BatchNorm", "BatchNorm_v1") and \
+                    not attrs.get("use_global_stats"):
+                momentum = attrs.get("momentum", 0.9)
+                # inputs 3,4 are the aux moving_mean/moving_var variables
+                for pos, stat_idx in ((3, 1), (4, 2)):
+                    p, _ = node.inputs[pos]
+                    if p.op is None:
+                        old = node_out(p, 0)
+                        aux_updates[p.name] = momentum * old + \
+                            (1 - momentum) * outs[stat_idx]
             return cache[key]
 
-        return [node_out(s._node, s._out_index)
-                for s in self._output_symbols()]
+        outputs = [node_out(s._node, s._out_index)
+                   for s in self._output_symbols()]
+        return outputs, aux_updates
 
     def eval_dict(self, arg_dict):
         """Evaluate with NDArray inputs → NDArray outputs (autograd-aware:
@@ -562,11 +595,13 @@ def load_json(json_str: str) -> Symbol:
                 raise MXNetError(f"op '{opname}' in JSON graph is not "
                                  "registered")
             opdef = get_op(opname)
+            from . import _node_num_outputs
+            parsed = {k: parse_attr(v) for k, v in attrs.items()}
             node = _Node(opname, jn["name"], attrs=dict(attrs),
                          inputs=[(nodes[nid], out_i)
                                  for nid, out_i, _ in jn["inputs"]],
-                         num_outputs=max(1, opdef.num_outputs)
-                         if opdef.num_outputs > 0 else 1)
+                         num_outputs=_node_num_outputs(opname, opdef,
+                                                       parsed))
         nodes.append(node)
     heads = data.get("heads", [[len(nodes) - 1, 0, 0]])
     outs = [Symbol(nodes[nid], out_i) for nid, out_i, _ in heads]

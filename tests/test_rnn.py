@@ -1,0 +1,127 @@
+"""RNN tests (reference model: tests/python/unittest/test_gluon_rnn.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import rnn
+
+
+@pytest.mark.parametrize("mode,cls", [("lstm", rnn.LSTM), ("gru", rnn.GRU),
+                                      ("rnn", rnn.RNN)])
+def test_fused_layer_shapes(mode, cls):
+    layer = cls(16, num_layers=2)
+    layer.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(5, 3, 8)
+                    .astype(np.float32))
+    out = layer(x)
+    assert out.shape == (5, 3, 16)
+    states = layer.begin_state(3)
+    out, st = layer(x, states)
+    assert out.shape == (5, 3, 16)
+    n_states = 2 if mode == "lstm" else 1
+    assert len(st) == n_states
+    assert st[0].shape == (2, 3, 16)
+
+
+def test_bidirectional_layer():
+    layer = rnn.LSTM(8, num_layers=1, bidirectional=True)
+    layer.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 2, 6)
+                    .astype(np.float32))
+    out = layer(x)
+    assert out.shape == (4, 2, 16)
+
+
+def test_ntc_layout():
+    layer = rnn.GRU(8, layout="NTC")
+    layer.initialize()
+    out = layer(mx.nd.array(np.random.RandomState(0).randn(2, 5, 4)
+                            .astype(np.float32)))
+    assert out.shape == (2, 5, 8)
+
+
+def test_lstm_matches_manual_cell():
+    """Fused scan LSTM must match a step-by-step LSTMCell unroll."""
+    mx.random.seed(0)
+    hidden = 6
+    layer = rnn.LSTM(hidden, input_size=4)
+    layer.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).randn(3, 2, 4)
+                    .astype(np.float32))
+    h0 = [mx.nd.zeros((1, 2, hidden)), mx.nd.zeros((1, 2, hidden))]
+    out, _ = layer(x, h0)
+
+    cell = rnn.LSTMCell(hidden, input_size=4, prefix="cell_")
+    cell.initialize()
+    # copy fused weights into the cell
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    outputs, _ = cell.unroll(3, x, layout="TNC", merge_outputs=False)
+    manual = np.stack([o.asnumpy() for o in outputs])
+    np.testing.assert_allclose(out.asnumpy(), manual, rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_gradients():
+    layer = rnn.LSTM(8, num_layers=2)
+    layer.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 2, 6)
+                    .astype(np.float32))
+    with mx.autograd.record():
+        loss = layer(x).sum()
+    loss.backward()
+    for name, p in layer.collect_params().items():
+        g = p.grad().asnumpy()
+        assert np.abs(g).sum() > 0, name
+
+
+def test_cells_stack_and_modifiers():
+    cell = rnn.SequentialRNNCell()
+    cell.add(rnn.LSTMCell(10, input_size=10))
+    cell.add(rnn.ResidualCell(rnn.GRUCell(10, input_size=10)))
+    cell.add(rnn.DropoutCell(0.3))
+    for c in (cell[0], cell[1].base_cell):
+        c.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(2, 4, 10)
+                    .astype(np.float32))
+    outputs, states = cell.unroll(4, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 4, 10)
+
+
+def test_bidirectional_cell():
+    l_cell = rnn.LSTMCell(4, input_size=3, prefix="l_")
+    r_cell = rnn.LSTMCell(4, input_size=3, prefix="r_")
+    bi = rnn.BidirectionalCell(l_cell, r_cell)
+    l_cell.initialize()
+    r_cell.initialize()
+    x = [mx.nd.array(np.random.RandomState(i).randn(2, 3)
+                     .astype(np.float32)) for i in range(5)]
+    outputs, states = bi.unroll(5, x, layout="NTC")
+    assert len(outputs) == 5
+    assert outputs[0].shape == (2, 8)
+
+
+def test_zoneout_runs():
+    cell = rnn.ZoneoutCell(rnn.RNNCell(4, input_size=4),
+                           zoneout_states=0.5)
+    cell.base_cell.initialize()
+    x = [mx.nd.ones((2, 4)) for _ in range(3)]
+    with mx.autograd.record():
+        outputs, _ = cell.unroll(3, x, layout="NTC")
+    assert outputs[0].shape == (2, 4)
+
+
+def test_bucket_sentence_iter():
+    from mxnet_tpu.rnn import BucketSentenceIter
+    rng = np.random.RandomState(0)
+    sentences = [list(rng.randint(1, 50, rng.randint(3, 20)))
+                 for _ in range(200)]
+    it = BucketSentenceIter(sentences, batch_size=8, buckets=[5, 10, 20])
+    batch = next(iter(it))
+    assert batch.bucket_key in (5, 10, 20)
+    assert batch.data[0].shape == (8, batch.bucket_key)
+    # label is data shifted by one
+    d = batch.data[0].asnumpy()
+    l = batch.label[0].asnumpy()
+    np.testing.assert_array_equal(d[:, 1:], l[:, :-1])

@@ -121,3 +121,51 @@ def test_executor_reshape():
     assert ex2.arg_dict["data"].shape == (16, 10)
     # weights shared (same shape → same arrays)
     assert ex2.arg_dict["fc1_weight"] is ex.arg_dict["fc1_weight"]
+
+
+def test_dropout_inference_identity():
+    """is_train=False must disable Dropout (regression: mask was baked into
+    the jitted forward)."""
+    data = mx.sym.var("data")
+    out = mx.sym.Dropout(data=data, p=0.5, name="drop")
+    x = mx.nd.array(np.random.RandomState(0).randn(8, 4).astype(np.float32))
+    ex = out.bind(mx.cpu(), {"data": x}, grad_req="null")
+    o1 = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(o1, x.asnumpy())
+    # training applies a mask, different across calls
+    t1 = ex.forward(is_train=True)[0].asnumpy()
+    t2 = ex.forward(is_train=True)[0].asnumpy()
+    assert not np.allclose(t1, x.asnumpy())
+    assert not np.allclose(t1, t2)
+
+
+def test_symbolic_batchnorm_trains():
+    """Training must use batch stats and update aux moving stats
+    (reference: batch_norm.cc)."""
+    data = mx.sym.var("data")
+    bn = mx.sym.BatchNorm(data=data, fix_gamma=False, name="bn")
+    x = np.random.RandomState(0).randn(16, 4).astype(np.float32) * 3 + 5
+    ex = bn.simple_bind(mx.cpu(), data=(16, 4))
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    ex.aux_dict["bn_moving_var"][:] = 1.0
+    out = ex.forward(is_train=True, data=x)[0].asnumpy()
+    # batch-normalized output: ~zero mean, unit var per channel
+    assert np.abs(out.mean(0)).max() < 1e-4
+    assert np.abs(out.std(0) - 1).max() < 1e-2
+    # aux stats moved toward batch stats
+    rm = ex.aux_dict["bn_moving_mean"].asnumpy()
+    assert np.abs(rm).sum() > 0
+
+
+def test_slice_channel_multi_output():
+    data = mx.sym.var("data")
+    s = mx.sym.SliceChannel(data, num_outputs=3, axis=1, name="slice")
+    assert len(s) == 3
+    assert len(s.list_outputs()) == 1  # selecting s[i] picks one output
+    ex = mx.sym.Group([s[0], s[2]]).bind(
+        mx.cpu(), {"data": mx.nd.array(np.arange(6).reshape(1, 6)
+                                       .astype(np.float32))},
+        grad_req="null")
+    outs = ex.forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), [[0, 1]])
+    np.testing.assert_allclose(outs[1].asnumpy(), [[4, 5]])
