@@ -38,6 +38,9 @@ from . import optimizer
 from . import lr_scheduler
 from . import metric
 from . import io
+from . import recordio
+from . import image
+from .io_native import CSVIter, LibSVMIter
 from . import kvstore
 from . import kvstore as kv
 from . import callback

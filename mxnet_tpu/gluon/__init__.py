@@ -9,5 +9,6 @@ from . import loss
 from . import trainer
 from .trainer import Trainer
 from . import utils
+from . import data
 from . import rnn
 from . import model_zoo

@@ -1,0 +1,247 @@
+"""Vision datasets (reference: python/mxnet/gluon/data/vision/datasets.py).
+
+MNIST/FashionMNIST/CIFAR read the standard binary formats from a local
+root (no network egress; point ``root`` at existing files or use
+``SyntheticImageDataset`` for smoke tests).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from .... import ndarray as nd
+from ....base import MXNetError
+from .. import dataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset",
+           "SyntheticImageDataset"]
+
+
+class _DownloadedDataset(dataset.Dataset):
+    """(reference: datasets.py:45)"""
+
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._root = os.path.expanduser(root)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from the standard idx-ubyte files (reference: datasets.py:60)."""
+
+    _train_data = ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz")
+    _test_data = ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz")
+
+    def __init__(self, root="~/.mxnet_tpu/datasets/mnist", train=True,
+                 transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        images, labels = self._train_data if self._train else self._test_data
+        img_path = os.path.join(self._root, images)
+        lbl_path = os.path.join(self._root, labels)
+        for p in (img_path, lbl_path):
+            if not os.path.exists(p) and not os.path.exists(p[:-3]):
+                raise MXNetError(
+                    f"MNIST file {p} not found; this environment has no "
+                    "network egress — place the standard MNIST files under "
+                    f"{self._root} (gzip or raw)")
+
+        def opener(p):
+            if os.path.exists(p):
+                return gzip.open(p, "rb")
+            return open(p[:-3], "rb")
+
+        with opener(lbl_path) as fin:
+            struct.unpack(">II", fin.read(8))
+            label = np.frombuffer(fin.read(), dtype=np.uint8)\
+                .astype(np.int32)
+        with opener(img_path) as fin:
+            _, num, rows, cols = struct.unpack(">IIII", fin.read(16))
+            data = np.frombuffer(fin.read(), dtype=np.uint8)
+            data = data.reshape(num, rows, cols, 1)
+        self._data = nd.array(data, dtype="uint8")
+        self._label = label
+
+
+class FashionMNIST(MNIST):
+    """(reference: datasets.py:103)"""
+
+    def __init__(self, root="~/.mxnet_tpu/datasets/fashion-mnist",
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR-10 from the python pickle batches (reference:
+    datasets.py:130)."""
+
+    def __init__(self, root="~/.mxnet_tpu/datasets/cifar10", train=True,
+                 transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _load_batches(self, names):
+        data, label = [], []
+        base = self._root
+        # accept either extracted dir or the tar.gz
+        tar = os.path.join(base, "cifar-10-python.tar.gz")
+        if os.path.exists(tar):
+            with tarfile.open(tar) as tf:
+                for n in names:
+                    with tf.extractfile(
+                            f"cifar-10-batches-py/{n}") as f:
+                        d = pickle.load(f, encoding="bytes")
+                    data.append(d[b"data"])
+                    label.append(d[b"labels"])
+        else:
+            for n in names:
+                p = os.path.join(base, "cifar-10-batches-py", n)
+                if not os.path.exists(p):
+                    p = os.path.join(base, n)
+                if not os.path.exists(p):
+                    raise MXNetError(
+                        f"CIFAR-10 batch {n} not found under {base}; place "
+                        "the python-version batches there (no network "
+                        "egress)")
+                with open(p, "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                data.append(d[b"data"])
+                label.append(d[b"labels"])
+        data = np.concatenate(data).reshape(-1, 3, 32, 32)\
+            .transpose(0, 2, 3, 1)
+        label = np.concatenate(label).astype(np.int32)
+        return data, label
+
+    def _get_data(self):
+        if self._train:
+            names = [f"data_batch_{i}" for i in range(1, 6)]
+        else:
+            names = ["test_batch"]
+        data, label = self._load_batches(names)
+        self._data = nd.array(data, dtype="uint8")
+        self._label = label
+
+
+class CIFAR100(CIFAR10):
+    """(reference: datasets.py:171)"""
+
+    def __init__(self, root="~/.mxnet_tpu/datasets/cifar100",
+                 fine_label=False, train=True, transform=None):
+        self._fine_label = fine_label
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        name = "train" if self._train else "test"
+        p = os.path.join(self._root, "cifar-100-python", name)
+        if not os.path.exists(p):
+            p = os.path.join(self._root, name)
+        if not os.path.exists(p):
+            raise MXNetError(f"CIFAR-100 file {name} not found under "
+                             f"{self._root}")
+        with open(p, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        data = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        key = b"fine_labels" if self._fine_label else b"coarse_labels"
+        self._data = nd.array(data, dtype="uint8")
+        self._label = np.asarray(d[key], np.int32)
+
+
+class ImageRecordDataset(dataset.RecordFileDataset):
+    """Images + labels from a .rec file (reference: datasets.py:217)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from .... import image as img_mod
+        from .... import recordio
+        record = super().__getitem__(idx)
+        header, img = recordio.unpack(record)
+        img = img_mod.imdecode(img, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(dataset.Dataset):
+    """root/category/image.jpg layout (reference: datasets.py:248)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                filename = os.path.join(path, filename)
+                ext = os.path.splitext(filename)[1]
+                if ext.lower() not in self._exts:
+                    continue
+                self.items.append((filename, label))
+
+    def __getitem__(self, idx):
+        from .... import image as img_mod
+        img = img_mod.imread(self.items[idx][0], self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)
+
+
+class SyntheticImageDataset(dataset.Dataset):
+    """Deterministic synthetic images for tests/benchmarks (TPU-rebuild
+    extra — the environment has no dataset downloads)."""
+
+    def __init__(self, num_samples=1000, shape=(3, 224, 224), classes=1000,
+                 seed=0):
+        self._n = num_samples
+        self._shape = shape
+        self._classes = classes
+        self._seed = seed
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self._seed + idx)
+        img = rng.randint(0, 256, (self._shape[1], self._shape[2],
+                                   self._shape[0])).astype(np.uint8)
+        label = int(rng.randint(self._classes))
+        return nd.array(img, dtype="uint8"), label

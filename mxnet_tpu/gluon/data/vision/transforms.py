@@ -1,0 +1,207 @@
+"""Vision transforms (reference:
+python/mxnet/gluon/data/vision/transforms.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .... import ndarray as nd
+from ....ndarray.ndarray import NDArray
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomHue", "RandomColorJitter",
+           "RandomLighting"]
+
+
+class Compose(Sequential):
+    """Sequentially compose transforms (reference: transforms.py:33)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    """(reference: transforms.py:70)"""
+
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """(H, W, C) uint8 [0,255] → (C, H, W) float32 [0,1]
+    (reference: transforms.py:90)."""
+
+    def hybrid_forward(self, F, x):
+        return x.astype("float32").transpose((2, 0, 1)) / 255.0
+
+
+class Normalize(HybridBlock):
+    """(reference: transforms.py:121)"""
+
+    def __init__(self, mean, std):
+        super().__init__()
+        self._mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self._std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+        self._mean_nd = None
+        self._std_nd = None
+
+    def hybrid_forward(self, F, x):
+        if self._mean_nd is None:
+            # cache device constants (one transfer, not two per image)
+            self._mean_nd = nd.array(self._mean)
+            self._std_nd = nd.array(self._std)
+        return (x - self._mean_nd) / self._std_nd
+
+
+class Resize(Block):
+    """(reference: transforms.py:279)"""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from .... import image as img_mod
+        if isinstance(self._size, int):
+            if self._keep:
+                return img_mod.resize_short(x, self._size,
+                                            self._interpolation)
+            return img_mod.imresize(x, self._size, self._size,
+                                    self._interpolation)
+        return img_mod.imresize(x, self._size[0], self._size[1],
+                                self._interpolation)
+
+
+class CenterCrop(Block):
+    """(reference: transforms.py:225)"""
+
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from .... import image as img_mod
+        return img_mod.center_crop(x, self._size, self._interpolation)[0]
+
+
+class RandomResizedCrop(Block):
+    """(reference: transforms.py:252)"""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from .... import image as img_mod
+        return img_mod.random_size_crop(x, self._size, self._scale,
+                                        self._ratio,
+                                        self._interpolation)[0]
+
+
+class RandomFlipLeftRight(Block):
+    """(reference: transforms.py:312)"""
+
+    def forward(self, x):
+        import random as pyrandom
+        if pyrandom.random() < 0.5:
+            x = nd.array(x.asnumpy()[:, ::-1])
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    """(reference: transforms.py:327)"""
+
+    def forward(self, x):
+        import random as pyrandom
+        if pyrandom.random() < 0.5:
+            x = nd.array(x.asnumpy()[::-1])
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._args = brightness
+
+    def forward(self, x):
+        from .... import image as img_mod
+        return img_mod.BrightnessJitterAug(self._args)(x)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._args = contrast
+
+    def forward(self, x):
+        from .... import image as img_mod
+        return img_mod.ContrastJitterAug(self._args)(x)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._args = saturation
+
+    def forward(self, x):
+        from .... import image as img_mod
+        return img_mod.SaturationJitterAug(self._args)(x)
+
+
+class RandomHue(Block):
+    def __init__(self, hue):
+        super().__init__()
+        self._args = hue
+
+    def forward(self, x):
+        from .... import image as img_mod
+        return img_mod.HueJitterAug(self._args)(x)
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._args = (brightness, contrast, saturation)
+        self._hue = hue
+
+    def forward(self, x):
+        from .... import image as img_mod
+        x = img_mod.ColorJitterAug(*self._args)(x)
+        if self._hue:
+            x = img_mod.HueJitterAug(self._hue)(x)
+        return x
+
+
+class RandomLighting(Block):
+    """(reference: transforms.py:423)"""
+
+    def __init__(self, alpha):
+        super().__init__()
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        self._aug = None
+        self._params = (alpha, eigval, eigvec)
+
+    def forward(self, x):
+        from .... import image as img_mod
+        if self._aug is None:
+            self._aug = img_mod.LightingAug(*self._params)
+        return self._aug(x)

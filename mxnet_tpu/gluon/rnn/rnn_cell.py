@@ -96,16 +96,20 @@ class RecurrentCell(Block):
         states = begin_state
         outputs = []
         for i in range(length):
-            output, states = self(inputs[i], states)
+            output, new_states = self(inputs[i], states)
+            if valid_length is not None:
+                # step i is valid for sequences with valid_length > i:
+                # mask the output to 0 and FREEZE the state at the last
+                # valid step (reference: rnn_cell.py SequenceLast handling)
+                mask = (valid_length > float(i)).astype(output.dtype)
+                mask_col = mask.reshape((-1, 1))
+                output = output * mask_col
+                states = [n * mask.reshape((-1,) + (1,) * (n.ndim - 1)) +
+                          s * (1 - mask.reshape((-1,) + (1,) * (n.ndim - 1)))
+                          for n, s in zip(new_states, states)]
+            else:
+                states = new_states
             outputs.append(output)
-        if valid_length is not None:
-            outputs = [F.where(
-                F.broadcast_lesser_equal(
-                    F._full(shape=(1,), value=float(i + 1)),
-                    valid_length.reshape((-1, 1))).broadcast_like(o)
-                if hasattr(F, "broadcast_lesser_equal") else o, o,
-                F.zeros_like(o))
-                for i, o in enumerate(outputs)]
         if merge_outputs:
             outputs = [o.expand_dims(axis=axis) for o in outputs]
             outputs = F.concat(*outputs, dim=axis)

@@ -1,0 +1,4 @@
+"""Image IO and augmentation (reference: python/mxnet/image/__init__.py)."""
+from .image import *  # noqa: F401,F403
+from . import detection  # noqa: F401
+from .detection import *  # noqa: F401,F403

@@ -1,0 +1,316 @@
+"""Detection-specific image augmentation
+(reference: python/mxnet/image/detection.py; native
+src/io/image_det_aug_default.cc, iter_image_det_recordio.cc:582).
+
+Labels are (N, 5+) arrays [class, xmin, ymin, xmax, ymax, ...] with
+normalized coordinates; augmenters transform image + boxes together.
+"""
+from __future__ import annotations
+
+import random as pyrandom
+
+import numpy as np
+
+from .. import io as io_mod
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+from . import image as img_mod
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateDetAugmenter", "ImageDetIter"]
+
+
+class DetAugmenter:
+    """(reference: detection.py:41)"""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap a classification augmenter that doesn't move pixels relative to
+    boxes (reference: detection.py:68)."""
+
+    def __init__(self, augmenter):
+        super().__init__(augmenter=augmenter.dumps())
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """(reference: detection.py:89)"""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        super().__init__(skip_prob=skip_prob)
+        self.aug_list = aug_list
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.skip_prob:
+            return src, label
+        aug = pyrandom.choice(self.aug_list)
+        return aug(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """(reference: detection.py:118)"""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            arr = src.asnumpy() if isinstance(src, NDArray) else src
+            src = nd.array(arr[:, ::-1])
+            label = np.array(label, copy=True)
+            tmp = 1.0 - label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = tmp
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """IoU-constrained random crop (reference: detection.py:142; SSD
+    data augmentation, Liu et al.)."""
+
+    def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75,
+                 1.33), area_range=(0.05, 1.0), min_eject_coverage=0.3,
+                 max_attempts=50):
+        super().__init__()
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+
+    def _check_satisfy(self, rect, boxes):
+        l, t, r, b = rect
+        ious = []
+        for box in boxes:
+            ix = max(0.0, min(r, box[3]) - max(l, box[1]))
+            iy = max(0.0, min(b, box[4]) - max(t, box[2]))
+            inter = ix * iy
+            union = (r - l) * (b - t) + \
+                (box[3] - box[1]) * (box[4] - box[2]) - inter
+            ious.append(inter / union if union > 0 else 0.0)
+        return ious and max(ious) >= self.min_object_covered
+
+    def _update_labels(self, label, crop):
+        l, t, r, b = crop
+        w, h = r - l, b - t
+        out = []
+        for obj in label:
+            cx = (obj[1] + obj[3]) / 2
+            cy = (obj[2] + obj[4]) / 2
+            if not (l <= cx <= r and t <= cy <= b):
+                continue
+            nl = (max(obj[1], l) - l) / w
+            nt = (max(obj[2], t) - t) / h
+            nr = (min(obj[3], r) - l) / w
+            nb = (min(obj[4], b) - t) / h
+            coverage = max(0.0, nr - nl) * max(0.0, nb - nt) * w * h / \
+                max((obj[3] - obj[1]) * (obj[4] - obj[2]), 1e-12)
+            if coverage < self.min_eject_coverage:
+                continue
+            out.append([obj[0], nl, nt, nr, nb] + list(obj[5:]))
+        return np.asarray(out, np.float32) if out else None
+
+    def __call__(self, src, label):
+        import math
+        label = np.asarray(label)
+        for _ in range(self.max_attempts):
+            area = pyrandom.uniform(*self.area_range)
+            ratio = math.exp(pyrandom.uniform(
+                math.log(self.aspect_ratio_range[0]),
+                math.log(self.aspect_ratio_range[1])))
+            w = min(1.0, math.sqrt(area * ratio))
+            h = min(1.0, math.sqrt(area / ratio))
+            l = pyrandom.uniform(0, 1 - w)
+            t = pyrandom.uniform(0, 1 - h)
+            rect = (l, t, l + w, t + h)
+            if not self._check_satisfy(rect, label):
+                continue
+            new_label = self._update_labels(label, rect)
+            if new_label is None:
+                continue
+            arr = src.asnumpy() if isinstance(src, NDArray) else src
+            H, W = arr.shape[:2]
+            x0, y0 = int(l * W), int(t * H)
+            x1, y1 = int((l + w) * W), int((t + h) * H)
+            return nd.array(arr[y0:y1, x0:x1]), new_label
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Zoom-out padding (reference: detection.py:285)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        super().__init__()
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        import math
+        label = np.asarray(label)
+        arr = src.asnumpy() if isinstance(src, NDArray) else src
+        H, W = arr.shape[:2]
+        for _ in range(self.max_attempts):
+            area = pyrandom.uniform(*self.area_range)
+            if area < 1.0:
+                continue
+            ratio = math.exp(pyrandom.uniform(
+                math.log(self.aspect_ratio_range[0]),
+                math.log(self.aspect_ratio_range[1])))
+            nw = int(W * math.sqrt(area * ratio))
+            nh = int(H * math.sqrt(area / ratio))
+            if nw < W or nh < H:
+                continue
+            x0 = pyrandom.randint(0, nw - W)
+            y0 = pyrandom.randint(0, nh - H)
+            canvas = np.full((nh, nw, arr.shape[2]), self.pad_val,
+                             arr.dtype)
+            canvas[y0:y0 + H, x0:x0 + W] = arr
+            new_label = np.array(label, copy=True)
+            new_label[:, 1] = (label[:, 1] * W + x0) / nw
+            new_label[:, 3] = (label[:, 3] * W + x0) / nw
+            new_label[:, 2] = (label[:, 2] * H + y0) / nh
+            new_label[:, 4] = (label[:, 4] * H + y0) / nh
+            return nd.array(canvas), new_label
+        return src, label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """(reference: detection.py:611)"""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(img_mod.ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (area_range[0], min(1.0, area_range[1])),
+                                min_eject_coverage, max_attempts)
+        auglist.append(DetRandomSelectAug([crop], 1 - rand_crop))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (max(1.0, area_range[0]), area_range[1]),
+                              max_attempts, pad_val)
+        auglist.append(DetRandomSelectAug([pad], 1 - rand_pad))
+    auglist.append(DetBorrowAug(img_mod.ForceResizeAug(
+        (data_shape[2], data_shape[1]), inter_method)))
+    auglist.append(DetBorrowAug(img_mod.CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(img_mod.ColorJitterAug(
+            brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(img_mod.HueJitterAug(hue)))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(DetBorrowAug(img_mod.LightingAug(pca_noise, eigval,
+                                                        eigvec)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(img_mod.RandomGrayAug(rand_gray)))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(DetBorrowAug(img_mod.ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(img_mod.ImageIter):
+    """Detection iterator: labels are (N, 5+) box arrays padded to a fixed
+    object count per batch (reference: detection.py:751)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", path_imgidx=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="label",
+                 last_batch_handle="pad", **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_crop", "rand_pad", "rand_gray",
+                         "rand_mirror", "mean", "std", "brightness",
+                         "contrast", "saturation", "pca_noise", "hue",
+                         "inter_method")})
+        super().__init__(batch_size=batch_size, data_shape=data_shape,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, path_imgidx=path_imgidx,
+                         shuffle=shuffle, part_index=part_index,
+                         num_parts=num_parts, aug_list=[], imglist=imglist,
+                         data_name=data_name, label_name=label_name,
+                         **kwargs)
+        self.det_auglist = aug_list
+        self.max_objects = kwargs.get("max_objects", 50)
+        self.label_obj_width = kwargs.get("label_width", 5)
+
+    @property
+    def provide_label(self):
+        return [io_mod.DataDesc(
+            self.label_name,
+            (self.batch_size, self.max_objects, self.label_obj_width))]
+
+    def _parse_label(self, label):
+        """Header label → (N, 5) boxes (reference: detection.py:845)."""
+        raw = np.asarray(label).ravel()
+        if raw.size >= 2 and raw[0] == 2:  # [2, obj_width, ...boxes]
+            obj_width = int(raw[1])
+            body = raw[2:]
+            return body.reshape(-1, obj_width)
+        return raw.reshape(-1, self.label_obj_width)
+
+    def next(self):
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = np.zeros((batch_size, h, w, c), np.float32)
+        batch_label = np.full(
+            (batch_size, self.max_objects, self.label_obj_width), -1.0,
+            np.float32)
+        i = 0
+        try:
+            while i < batch_size:
+                raw_label, data = self.next_sample()
+                boxes = self._parse_label(raw_label)
+                for aug in self.det_auglist:
+                    data, boxes = aug(data, boxes)
+                arr = data.asnumpy() if isinstance(data, NDArray) else data
+                if arr.ndim == 2:
+                    arr = arr[:, :, None]
+                batch_data[i] = arr
+                n = min(len(boxes), self.max_objects)
+                if n:
+                    batch_label[i, :n] = boxes[:n, :self.label_obj_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        pad = batch_size - i
+        return io_mod.DataBatch(
+            [nd.array(batch_data.transpose(0, 3, 1, 2))],
+            [nd.array(batch_label)], pad=pad)

