@@ -315,6 +315,9 @@ def stateful_write(param, value):
         param._check_and_get()._data = data
 
 
+_sym_trace_vars = threading.local()
+
+
 class HybridBlock(Block):
     """A Block that can be staged into a single XLA computation
     (reference: block.py:376-598; CachedOp analog src/imperative/cached_op.cc).
@@ -383,13 +386,38 @@ class HybridBlock(Block):
                 p._finish_deferred_init()
 
     def __call__(self, *args):
+        from ..symbol.symbol import Symbol as _Sym
+        if args and isinstance(args[0], _Sym):
+            return self.forward(*args)
         if self._active and _TraceState.active() is None:
             return self._call_cached(*args)
         return self.forward(*args)
 
     def forward(self, x, *args):
         """Gather this block's params and defer to ``hybrid_forward``
-        (reference: block.py:541-560)."""
+        (reference: block.py:541-560).
+
+        When ``x`` is a Symbol the forward composes the symbolic graph
+        instead: parameters become variables named by their full name, with
+        grad_req=='null' ones marked auxiliary (the reference builds this
+        graph in _get_graph, block.py:468)."""
+        from ..symbol.symbol import Symbol as _Sym
+        if isinstance(x, _Sym):
+            from .. import symbol as sym_module
+            from ..symbol.symbol import var as _sym_var
+            cache = getattr(_sym_trace_vars, "vars", None)
+            params = {}
+            for name, p in self._reg_params.items():
+                if cache is not None and p.name in cache:
+                    v = cache[p.name]
+                else:
+                    v = _sym_var(p.name)
+                    if p.grad_req == "null":
+                        v._node.attrs["__is_aux__"] = True
+                    if cache is not None:
+                        cache[p.name] = v
+                params[name] = v
+            return self.hybrid_forward(sym_module, x, *args, **params)
         try:
             params = self._gather_params()
         except DeferredInitializationError:
@@ -509,13 +537,56 @@ class HybridBlock(Block):
                 p._check_and_get()._data = w
         return out_nds[0] if len(out_nds) == 1 else tuple(out_nds)
 
-    def export(self, path, epoch=0):
-        """Export model params + a structural graph description
-        (reference: block.py export — symbol JSON + params)."""
-        params = {"arg:" + name: p._check_and_get()
-                  for name, p in self.collect_params().items()}
+    def export(self, path, epoch=0, num_inputs=1):
+        """Export to ``<path>-symbol.json`` + ``<path>-NNNN.params``
+        (reference: block.py:590 export — the symbol/params pair that
+        Module.load / mx.model.load_checkpoint consumes).
+
+        The graph is traced symbolically (inference mode); parameters are
+        classified into ``arg:``/``aux:`` keys via the traced symbol's
+        list_arguments/list_auxiliary_states, falling back to the
+        grad_req=='null' aux convention for params the trace didn't touch.
+        """
+        sym = self._trace_symbol(num_inputs=num_inputs)
+        sym.save(f"{path}-symbol.json")
+        aux_names = set(sym.list_auxiliary_states())
+        arg_names = set(sym.list_arguments())
+        params = {}
+        for name, p in self.collect_params().items():
+            if name in aux_names:
+                key = "aux:" + name
+            elif name in arg_names:
+                key = "arg:" + name
+            else:
+                key = ("aux:" if p.grad_req == "null" else "arg:") + name
+            params[key] = p._check_and_get()
         from ..ndarray import save as nd_save
         nd_save(f"{path}-{epoch:04d}.params", params)
+        return sym
+
+    def _trace_symbol(self, num_inputs=1):
+        """Trace this block into a Symbol graph (inference mode).
+
+        Input variables are named ``data`` (single input) or ``data0..N``,
+        matching the reference's export convention."""
+        from ..symbol.symbol import var as _sym_var
+        if num_inputs == 1:
+            inputs = [_sym_var("data")]
+        else:
+            inputs = [_sym_var(f"data{i}") for i in range(num_inputs)]
+        _sym_trace_vars.vars = {}
+        prev_t = autograd.set_training(False)
+        prev_r = autograd.set_recording(False)
+        try:
+            out = self.forward(*inputs)
+        finally:
+            autograd.set_recording(prev_r)
+            autograd.set_training(prev_t)
+            _sym_trace_vars.vars = None
+        if isinstance(out, tuple):
+            from ..symbol.symbol import Group
+            return Group([o for o in out])
+        return out
 
 
 class SymbolBlock(HybridBlock):
@@ -527,10 +598,15 @@ class SymbolBlock(HybridBlock):
     def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix=None, params=params)
         from .. import symbol as _sym
+        from .parameter import ParameterDict
         if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
             outputs = outputs[0]
         self._outputs = outputs
         self._inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        # graph params keep their raw symbol names — no block prefix
+        # (reference: block.py SymbolBlock uses the unprefixed shared dict)
+        self._params = ParameterDict("", shared=self._params._shared
+                                     if params is None else params)
         input_names = {i.name for i in self._inputs}
         for name in outputs.list_arguments():
             if name not in input_names:

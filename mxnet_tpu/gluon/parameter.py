@@ -412,8 +412,10 @@ class ParameterDict:
         arg_dict = {}
         for param in self.values():
             block = param.list_data()
-            weight = sum(b.copyto(cpu()) for b in block[1:]) if len(block) > 1 \
-                else block[0]
+            if len(block) > 1:
+                weight = sum(b.copyto(cpu()) for b in block) / len(block)
+            else:
+                weight = block[0]
             if not param.name.startswith(strip_prefix):
                 raise ValueError(
                     f"Prefix '{strip_prefix}' is to be stripped before saving, "

@@ -35,6 +35,8 @@ def _make_op_func(opdef):
         ctx = kwargs.pop("ctx", None)
         nd_args = []
         for a in args:
+            if a is None:
+                continue  # optional trailing inputs (e.g. CTCLoss lengths)
             if isinstance(a, NDArray):
                 nd_args.append(a)
             elif isinstance(a, (list, tuple)) and a and isinstance(a[0], NDArray):

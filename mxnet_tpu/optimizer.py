@@ -717,6 +717,8 @@ class Updater:
         def to_nd(s):
             if isinstance(s, np.ndarray):
                 return _nd_mod.array(s)
+            if isinstance(s, _MPState):
+                return _MPState(to_nd(s.master), to_nd(s.inner))
             if isinstance(s, (tuple, list)):
                 return type(s)(to_nd(x) for x in s)
             return s
@@ -729,6 +731,8 @@ class Updater:
         def to_np(s):
             if isinstance(s, NDArray):
                 return s.asnumpy()
+            if isinstance(s, _MPState):
+                return _MPState(to_np(s.master), to_np(s.inner))
             if isinstance(s, (tuple, list)):
                 return type(s)(to_np(x) for x in s)
             return s

@@ -161,10 +161,16 @@ class TrainStep:
                 shard = [rep] * len(pvals)
             pvals = tuple(jax.device_put(v, s)
                           for v, s in zip(pvals, shard))
+            # state leaves only inherit the param's sharding when they have
+            # the param's shape; scalar leaves (e.g. adam's step counter t)
+            # are replicated — a non-empty spec on a rank-0 array is invalid
             opt_state = tuple(
-                tuple(jax.device_put(x, s) if hasattr(x, "shape") else x
+                tuple(jax.device_put(
+                          x, s if getattr(x, "shape", None) == v.shape
+                          else rep)
+                      if hasattr(x, "shape") else x
                       for x in st)
-                for st, s in zip(opt_state, shard))
+                for st, s, v in zip(opt_state, shard, pvals))
         self._pvals = pvals
         self._opt_state = opt_state
 
@@ -224,8 +230,10 @@ class TrainStep:
             else:
                 pshard = tuple(rep for _ in self.param_list)
             sshard = tuple(
-                tuple(ps for _ in st) if st else ()
-                for ps, st in zip(pshard, self._opt_state))
+                tuple(ps if getattr(leaf, "shape", None)
+                      == getattr(pv, "shape", None) else rep
+                      for leaf in st) if st else ()
+                for ps, st, pv in zip(pshard, self._opt_state, self._pvals))
             in_shardings = (pshard, sshard, batch1, batch1, rep, rep)
             self._step_jit = jax.jit(step_fn, donate_argnums=donate,
                                      in_shardings=in_shardings)

@@ -219,6 +219,53 @@ class Symbol:
         from . import _symbol_op
         return _symbol_op("negative", [self], {})
 
+    # -- fluent methods (reference: symbol.py fluent-method codegen) ---------
+    def _unop(self, op_name, **attrs):
+        from . import _symbol_op
+        return _symbol_op(op_name, [self],
+                          {k: v for k, v in attrs.items() if v is not None})
+
+    def reshape(self, shape, **kwargs):
+        if isinstance(shape, int):
+            shape = (shape,)
+        return self._unop("Reshape", shape=tuple(shape), **kwargs)
+
+    def flatten(self):
+        return self._unop("Flatten")
+
+    def transpose(self, axes=None):
+        return self._unop("transpose", axes=axes)
+
+    def swapaxes(self, dim1, dim2):
+        return self._unop("SwapAxis", dim1=dim1, dim2=dim2)
+
+    def expand_dims(self, axis):
+        return self._unop("expand_dims", axis=axis)
+
+    def squeeze(self, axis=None):
+        return self._unop("squeeze", axis=axis)
+
+    def astype(self, dtype):
+        return self._unop("Cast", dtype=str(np.dtype(dtype)))
+
+    def sum(self, axis=None, keepdims=False):
+        return self._unop("sum", axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._unop("mean", axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._unop("max", axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._unop("min", axis=axis, keepdims=keepdims)
+
+    def clip(self, a_min, a_max):
+        return self._unop("clip", a_min=a_min, a_max=a_max)
+
+    def slice_axis(self, axis, begin, end):
+        return self._unop("slice_axis", axis=axis, begin=begin, end=end)
+
     # -- evaluation ----------------------------------------------------------
     def _output_symbols(self):
         return list(self._group) if self._group is not None else [self]

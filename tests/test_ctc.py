@@ -1,0 +1,102 @@
+"""CTC loss op + gluon CTCLoss, validated against torch's reference CTC
+(reference analog: src/operator/contrib/ctc_loss.cc, tested by
+tests/python/unittest/test_operator.py test_ctc_loss)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+torch = pytest.importorskip("torch")
+
+
+def _torch_ctc(pred_tnc, label, t_lens, l_lens, blank):
+    lp = torch.log_softmax(torch.tensor(pred_tnc), dim=-1)
+    return torch.nn.functional.ctc_loss(
+        lp, torch.tensor(label, dtype=torch.long),
+        torch.tensor(t_lens, dtype=torch.long),
+        torch.tensor(l_lens, dtype=torch.long),
+        blank=blank, reduction="none", zero_infinity=False).numpy()
+
+
+def test_ctc_op_matches_torch():
+    rng = np.random.RandomState(0)
+    T, N, C, L = 20, 4, 6, 5
+    data = rng.randn(T, N, C).astype(np.float32)
+    labels = rng.randint(0, C - 1, (N, L)).astype(np.float32)
+    t_lens = np.array([20, 18, 15, 20], np.int32)
+    l_lens = np.array([5, 3, 4, 2], np.int32)
+    out = mx.nd.CTCLoss(
+        mx.nd.array(data), mx.nd.array(labels),
+        mx.nd.array(t_lens), mx.nd.array(l_lens),
+        use_data_lengths=True, use_label_lengths=True,
+        blank_label="last").asnumpy()
+    ref = _torch_ctc(data, labels, t_lens, l_lens, blank=C - 1)
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_ctc_op_blank_first_padding():
+    rng = np.random.RandomState(1)
+    T, N, C, L = 15, 3, 8, 6
+    data = rng.randn(T, N, C).astype(np.float32)
+    # blank_label='first': blank id 0, labels 1..C-1, pad with 0
+    l_lens = np.array([6, 4, 2], np.int32)
+    labels = np.zeros((N, L), np.float32)
+    for i, ll in enumerate(l_lens):
+        labels[i, :ll] = rng.randint(1, C, ll)
+    out = mx.nd.CTCLoss(mx.nd.array(data), mx.nd.array(labels),
+                        blank_label="first").asnumpy()
+    ref = _torch_ctc(data, labels, np.full((N,), T, np.int32), l_lens,
+                     blank=0)
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_ctc_gradient_matches_torch():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import get_op
+    rng = np.random.RandomState(2)
+    T, N, C, L = 12, 2, 5, 3
+    data = rng.randn(T, N, C).astype(np.float32)
+    labels = rng.randint(0, C - 1, (N, L)).astype(np.float32)
+    op = get_op("CTCLoss")
+
+    def f(d):
+        return op.fn(d, jnp.asarray(labels), blank_label="last").sum()
+
+    g = np.asarray(jax.grad(f)(jnp.asarray(data)))
+    dt = torch.tensor(data, requires_grad=True)
+    lp = torch.log_softmax(dt, dim=-1)
+    torch.nn.functional.ctc_loss(
+        lp, torch.tensor(labels, dtype=torch.long),
+        torch.full((N,), T, dtype=torch.long),
+        torch.full((N,), L, dtype=torch.long),
+        blank=C - 1, reduction="sum").backward()
+    np.testing.assert_allclose(g, dt.grad.numpy(), atol=1e-3)
+
+
+def test_gluon_ctc_loss():
+    from mxnet_tpu.gluon.loss import CTCLoss
+    rng = np.random.RandomState(3)
+    N, T, C, L = 4, 20, 6, 5
+    pred = rng.randn(N, T, C).astype(np.float32)  # NTC layout
+    label = rng.randint(0, C - 1, (N, L)).astype(np.float32)
+    out = CTCLoss()(mx.nd.array(pred), mx.nd.array(label)).asnumpy()
+    ref = _torch_ctc(pred.transpose(1, 0, 2), label,
+                     np.full((N,), T, np.int32), np.full((N,), L, np.int32),
+                     blank=C - 1)
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_gluon_ctc_loss_tnc_with_lengths():
+    from mxnet_tpu.gluon.loss import CTCLoss
+    rng = np.random.RandomState(4)
+    T, N, C, L = 18, 3, 7, 4
+    pred = rng.randn(T, N, C).astype(np.float32)
+    label = rng.randint(0, C - 1, (N, L)).astype(np.float32)
+    t_lens = np.array([18, 12, 16], np.int32)
+    l_lens = np.array([4, 2, 3], np.int32)
+    out = CTCLoss(layout="TNC")(
+        mx.nd.array(pred), mx.nd.array(label),
+        mx.nd.array(t_lens), mx.nd.array(l_lens)).asnumpy()
+    ref = _torch_ctc(pred, label, t_lens, l_lens, blank=C - 1)
+    np.testing.assert_allclose(out, ref, atol=1e-4)

@@ -1,0 +1,88 @@
+"""HybridBlock.export → symbol.json + arg:/aux: params, loadable by
+Module/load_checkpoint (reference: gluon/block.py:590 export,
+module load_checkpoint round-trip in tests/python/unittest/test_module.py)."""
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def _small_net():
+    net = nn.HybridSequential(prefix="net_")
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.add(nn.GlobalAvgPool2D())
+        net.add(nn.Dense(5))
+    return net
+
+
+def test_export_writes_symbol_and_split_params(tmp_path):
+    net = _small_net()
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).randn(2, 3, 16, 16)
+                    .astype(np.float32))
+    net(x)
+    path = os.path.join(str(tmp_path), "m")
+    net.export(path, epoch=7)
+    assert os.path.exists(path + "-symbol.json")
+    assert os.path.exists(path + "-0007.params")
+    params = mx.nd.load(path + "-0007.params")
+    keys = set(params)
+    # BatchNorm running stats must land under aux:, weights under arg:
+    assert any(k.startswith("aux:") and "running_mean" in k for k in keys)
+    assert any(k.startswith("arg:") and "weight" in k for k in keys)
+    assert not any(k.startswith("arg:") and "running" in k for k in keys)
+
+
+def test_export_round_trip_through_load_checkpoint(tmp_path):
+    net = _small_net()
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(1).randn(2, 3, 16, 16)
+                    .astype(np.float32))
+    out_ref = net(x).asnumpy()
+    path = os.path.join(str(tmp_path), "m")
+    net.export(path)
+    sym, arg_params, aux_params = mx.model.load_checkpoint(path, 0)
+    ex = sym.bind(mx.cpu(), dict(arg_params, data=x), aux_states=aux_params)
+    out = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out, out_ref, atol=1e-4)
+
+
+def test_export_resnet_round_trip(tmp_path):
+    from mxnet_tpu.gluon.model_zoo.vision import get_resnet
+    net = get_resnet(1, 18, classes=10)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(2).randn(2, 3, 32, 32)
+                    .astype(np.float32))
+    out_ref = net(x).asnumpy()
+    path = os.path.join(str(tmp_path), "resnet")
+    sym = net.export(path, epoch=1)
+    assert len(sym.list_auxiliary_states()) > 0
+    sym2, arg_params, aux_params = mx.model.load_checkpoint(path, 1)
+    ex = sym2.bind(mx.cpu(), dict(arg_params, data=x),
+                   aux_states=aux_params)
+    out = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out, out_ref, atol=1e-4)
+
+
+def test_symbolblock_from_exported(tmp_path):
+    net = _small_net()
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(3).randn(2, 3, 16, 16)
+                    .astype(np.float32))
+    out_ref = net(x).asnumpy()
+    path = os.path.join(str(tmp_path), "m")
+    net.export(path)
+    sym = mx.sym.load(path + "-symbol.json")
+    params = mx.nd.load(path + "-0000.params")
+    inputs = mx.sym.var("data")
+    sblock = gluon.SymbolBlock(sym, inputs)
+    sblock.collect_params().load(path + "-0000.params", allow_missing=False,
+                                 ignore_extra=True)
+    out = sblock(x).asnumpy()
+    np.testing.assert_allclose(out, out_ref, atol=1e-4)
