@@ -1,0 +1,180 @@
+"""Data iterators for the image-classification examples.
+
+Capability analog of the reference's example/image-classification/common/
+data.py (get_rec_iter over ImageRecordIter) with an added synthetic mode so
+the examples run hermetically (no dataset download; the image lives on a
+zero-egress TPU host).
+"""
+from __future__ import annotations
+
+import argparse
+import gzip
+import os
+import struct
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def add_data_args(parser: argparse.ArgumentParser):
+    data = parser.add_argument_group("Data", "the input images")
+    data.add_argument("--data-train", type=str, help="the training data (.rec)")
+    data.add_argument("--data-val", type=str, help="the validation data (.rec)")
+    data.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939",
+                      help="a tuple of size 3 for the mean rgb")
+    data.add_argument("--image-shape", type=str, default="3,224,224",
+                      help="the image shape feed into the network")
+    data.add_argument("--num-classes", type=int, default=1000,
+                      help="the number of classes")
+    data.add_argument("--num-examples", type=int, default=1281167,
+                      help="the number of training examples")
+    data.add_argument("--data-nthreads", type=int, default=4,
+                      help="number of decode workers")
+    data.add_argument("--benchmark", type=int, default=0,
+                      help="if 1, run on synthetic data of --image-shape")
+    return data
+
+
+def add_aug_args(parser: argparse.ArgumentParser):
+    aug = parser.add_argument_group("Augmentation", "image augmentations")
+    aug.add_argument("--random-crop", type=int, default=1)
+    aug.add_argument("--random-mirror", type=int, default=1)
+    aug.add_argument("--max-random-scale", type=float, default=1)
+    aug.add_argument("--min-random-scale", type=float, default=1)
+    return aug
+
+
+class SyntheticDataIter(mx.io.DataIter):
+    """Deterministic learnable synthetic classification batches.
+
+    Each class is a fixed random prototype; samples are prototype + noise,
+    so small trainings genuinely converge (used by the train tests). With
+    ``learnable=False`` it is pure random data like the reference's
+    --benchmark mode.
+    """
+
+    def __init__(self, num_classes, data_shape, num_batches=100,
+                 dtype="float32", label_name="softmax_label",
+                 learnable=False, noise=0.3, seed=0, proto_seed=42):
+        super().__init__()
+        self.batch_size = data_shape[0]
+        self.cur_batch = 0
+        self.num_batches = num_batches
+        rng = np.random.RandomState(seed)
+        if learnable:
+            # distinct batches drawn from per-class prototypes so the
+            # training signal is real (not one memorized batch); the
+            # prototypes are seeded separately so train/val iterators with
+            # different sample seeds describe the SAME task
+            n = self.batch_size * num_batches
+            label = rng.randint(0, num_classes, (n,))
+            protos = np.random.RandomState(proto_seed).randn(
+                num_classes, *data_shape[1:])
+            data = protos[label] + noise * rng.randn(n, *data_shape[1:])
+            self.data = [mx.nd.array(
+                data[i * self.batch_size:(i + 1) * self.batch_size]
+                .astype(dtype)) for i in range(num_batches)]
+            self.label = [mx.nd.array(
+                label[i * self.batch_size:(i + 1) * self.batch_size]
+                .astype(np.float32)) for i in range(num_batches)]
+        else:
+            # pure-throughput mode: one random batch repeated (reference
+            # --benchmark semantics; data content is irrelevant)
+            label = rng.randint(0, num_classes, (self.batch_size,))
+            data = rng.uniform(-1, 1, data_shape)
+            self.data = [mx.nd.array(data.astype(dtype))]
+            self.label = [mx.nd.array(label.astype(np.float32))]
+        self.data_shape = data_shape
+        self.label_name = label_name
+
+    @property
+    def provide_data(self):
+        return [mx.io.DataDesc("data", self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [mx.io.DataDesc(self.label_name, (self.batch_size,))]
+
+    def reset(self):
+        self.cur_batch = 0
+
+    def next(self):
+        if self.cur_batch >= self.num_batches:
+            raise StopIteration
+        i = self.cur_batch % len(self.data)
+        self.cur_batch += 1
+        return mx.io.DataBatch(data=[self.data[i]], label=[self.label[i]],
+                               pad=0, index=None)
+
+
+def _read_mnist_images(path):
+    with gzip.open(path, "rb") as f:
+        _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(n, 1, rows, cols).astype(np.float32) / 255.0
+
+
+def _read_mnist_labels(path):
+    with gzip.open(path, "rb") as f:
+        struct.unpack(">II", f.read(8))
+        return np.frombuffer(f.read(), dtype=np.uint8).astype(np.float32)
+
+
+def get_mnist_iter(args, kv=None):
+    """MNIST train/val iterators.
+
+    Looks for the idx-ubyte files under --data-dir (reference
+    train_mnist.py downloads them; this host has no egress, so absent
+    files fall back to a learnable synthetic set of the same shape).
+    """
+    data_dir = getattr(args, "data_dir", "data/mnist")
+    names = ["train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz",
+             "t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"]
+    paths = [os.path.join(data_dir, n) for n in names]
+    if all(os.path.exists(p) for p in paths):
+        tx, ty = _read_mnist_images(paths[0]), _read_mnist_labels(paths[1])
+        vx, vy = _read_mnist_images(paths[2]), _read_mnist_labels(paths[3])
+        train = mx.io.NDArrayIter(tx, ty, args.batch_size, shuffle=True)
+        val = mx.io.NDArrayIter(vx, vy, args.batch_size)
+        return train, val
+    shape = (args.batch_size, 1, 28, 28)
+    train = SyntheticDataIter(10, shape, num_batches=60, learnable=True,
+                              noise=0.5, seed=0)
+    val = SyntheticDataIter(10, shape, num_batches=10, learnable=True,
+                            noise=0.5, seed=0)
+    return train, val
+
+
+def get_rec_iter(args, kv=None):
+    """RecordIO train/val iterators (reference common/data.py:109
+    get_rec_iter → ImageRecordIter); --benchmark 1 → synthetic."""
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    if args.benchmark:
+        shape = (args.batch_size,) + image_shape
+        train = SyntheticDataIter(args.num_classes, shape,
+                                  num_batches=getattr(args, "num_batches", 50))
+        return train, None
+    rank, nworker = (kv.rank, kv.num_workers) if kv else (0, 1)
+    mean = [float(x) for x in args.rgb_mean.split(",")]
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train,
+        batch_size=args.batch_size,
+        data_shape=image_shape,
+        mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
+        rand_crop=bool(args.random_crop),
+        rand_mirror=bool(args.random_mirror),
+        shuffle=True,
+        num_parts=nworker, part_index=rank,
+        preprocess_threads=args.data_nthreads)
+    if not args.data_val:
+        return train, None
+    val = mx.io.ImageRecordIter(
+        path_imgrec=args.data_val,
+        batch_size=args.batch_size,
+        data_shape=image_shape,
+        mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
+        rand_crop=False, rand_mirror=False, shuffle=False,
+        num_parts=nworker, part_index=rank,
+        preprocess_threads=args.data_nthreads)
+    return train, val

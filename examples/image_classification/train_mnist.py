@@ -1,0 +1,44 @@
+"""Train MLP/LeNet on MNIST (reference: example/image-classification/
+train_mnist.py).
+
+    python train_mnist.py --network mlp
+    python train_mnist.py --network lenet --num-epochs 5
+
+Without the MNIST idx files under --data-dir the script trains on a
+learnable synthetic set of the same shape (this host has no egress).
+"""
+import argparse
+import importlib
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import mxnet_tpu as mx
+from common import data, fit
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train mnist",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--num-examples", type=int, default=60000)
+    parser.add_argument("--add_stn", action="store_true")
+    parser.add_argument("--data-dir", type=str, default="data/mnist")
+    fit.add_fit_args(parser)
+    parser.set_defaults(network="mlp", num_epochs=10,
+                        lr=0.05, lr_step_epochs="10", batch_size=64,
+                        disp_batches=100)
+    args = parser.parse_args()
+
+    net = importlib.import_module("symbols." + args.network).get_symbol(
+        num_classes=args.num_classes)
+
+    fit.fit(args, net, data.get_mnist_iter)
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    main()

@@ -47,6 +47,8 @@ from . import callback
 from . import model
 from . import module
 from . import module as mod
+from . import monitor
+from . import monitor as mon
 from . import gluon
 from . import rnn
 from . import parallel

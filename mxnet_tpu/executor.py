@@ -75,6 +75,7 @@ class Executor:
         self.output_names = symbol.list_outputs()
         self.outputs: List[NDArray] = []
         self._monitor_callback = None
+        self._monitor_all = False
         self._fwd_jit = None
         self._vjp_fn = None
         self._is_train = False
@@ -168,11 +169,25 @@ class Executor:
         from . import random as _random
         arg_vals = tuple(self.arg_dict[n]._data for n in self.arg_names)
         aux_vals = tuple(self.aux_dict[n]._data for n in self.aux_names)
-        outs, aux_updates = self._fwd_jit(arg_vals, aux_vals,
-                                          _random.next_key(), bool(is_train))
+        if self._monitor_callback is not None and self._monitor_all:
+            # interpreted pass capturing every op output for the Monitor
+            # (reference: GraphExecutor ExecuteMonCallback :1445); slower
+            # than the jit path — monitoring is a debug mode there too
+            amap = {n: v for n, v in zip(self.arg_names, arg_vals)}
+            amap.update(zip(self.aux_names, aux_vals))
+            internals = {}
+            outs, aux_updates = self._symbol.eval_arrays_ex(
+                amap, training=bool(is_train), rng_key=_random.next_key(),
+                internals=internals)
+            for name, o in internals.items():
+                self._monitor_callback(name, _wrap(o))
+        else:
+            outs, aux_updates = self._fwd_jit(arg_vals, aux_vals,
+                                              _random.next_key(),
+                                              bool(is_train))
         self.outputs = [_wrap(o) for o in outs]
         self._apply_aux_updates(aux_updates)
-        if self._monitor_callback is not None:
+        if self._monitor_callback is not None and not self._monitor_all:
             for name, o in zip(self.output_names, self.outputs):
                 self._monitor_callback(name, o)
         return self.outputs
@@ -218,6 +233,7 @@ class Executor:
         """(reference: executor.py set_monitor_callback;
         GraphExecutor graph_executor.cc:121)"""
         self._monitor_callback = callback
+        self._monitor_all = monitor_all
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):

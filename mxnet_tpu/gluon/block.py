@@ -406,16 +406,28 @@ class HybridBlock(Block):
             from .. import symbol as sym_module
             from ..symbol.symbol import var as _sym_var
             cache = getattr(_sym_trace_vars, "vars", None)
+            if cache is None:
+                # direct net(symbol) call outside _trace_symbol: dedupe
+                # variables per thread so a Parameter shared by two blocks
+                # maps to ONE node (two same-named nodes confuse bind)
+                if not hasattr(_sym_trace_vars, "fallback"):
+                    _sym_trace_vars.fallback = {}
+                cache = _sym_trace_vars.fallback
             params = {}
             for name, p in self._reg_params.items():
-                if cache is not None and p.name in cache:
-                    v = cache[p.name]
-                else:
+                v = cache.get(p.name)
+                if v is not None and \
+                        bool(v._node.attrs.get("__is_aux__")) != \
+                        (p.grad_req == "null"):
+                    # grad_req classification changed since the node was
+                    # cached: mint a fresh node rather than mutating one
+                    # embedded in previously built graphs
+                    v = None
+                if v is None:
                     v = _sym_var(p.name)
                     if p.grad_req == "null":
                         v._node.attrs["__is_aux__"] = True
-                    if cache is not None:
-                        cache[p.name] = v
+                    cache[p.name] = v
                 params[name] = v
             return self.hybrid_forward(sym_module, x, *args, **params)
         try:

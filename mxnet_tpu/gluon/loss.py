@@ -155,7 +155,11 @@ class CTCLoss(Loss):
             pred = pred.swapaxes(0, 1)
         if self._batch_axis == 1:
             label = label.swapaxes(0, 1)
-        loss = F.CTCLoss(pred, label, pred_lengths, label_lengths,
+        # lengths passed by keyword so they bind by name in both the nd and
+        # sym paths (a positional None must never shift later inputs left)
+        loss = F.CTCLoss(pred, label,
+                         data_lengths=pred_lengths,
+                         label_lengths=label_lengths,
                          use_data_lengths=pred_lengths is not None,
                          use_label_lengths=label_lengths is not None,
                          blank_label="last")

@@ -417,3 +417,52 @@ def CSVIter(*args, **kwargs):
     io_native once available."""
     from .io_native import CSVIter as _CSVIter
     return _CSVIter(*args, **kwargs)
+
+
+def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
+                    mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                    std_r=1.0, std_g=1.0, std_b=1.0,
+                    rand_crop=False, rand_mirror=False, shuffle=False,
+                    num_parts=1, part_index=0, preprocess_threads=0,
+                    prefetch_buffer=2, resize=0, data_name="data",
+                    label_name="softmax_label", **kwargs):
+    """RecordIO image iterator with the reference's parameter surface
+    (reference: src/io/iter_image_recordio_2.cc:727 ImageRecordIter).
+
+    Decode + augmentation run host-side in Python (the reference used an
+    OpenCV thread pool, so ``preprocess_threads`` is accepted for parity
+    but decode runs on the prefetch thread); ``prefetch_buffer=0`` disables
+    the background prefetch thread and returns the bare iterator.
+    """
+    mean = None
+    if mean_r or mean_g or mean_b:
+        mean = np.array([mean_r, mean_g, mean_b])
+    std = None
+    if (std_r, std_g, std_b) != (1.0, 1.0, 1.0):
+        std = np.array([std_r, std_g, std_b])
+    from .image.image import ImageIter
+    it = ImageIter(batch_size=batch_size, data_shape=data_shape,
+                   label_width=label_width, path_imgrec=path_imgrec,
+                   shuffle=shuffle, num_parts=num_parts,
+                   part_index=part_index, rand_crop=rand_crop,
+                   rand_mirror=rand_mirror, mean=mean, std=std,
+                   resize=resize, data_name=data_name,
+                   label_name=label_name, **kwargs)
+    if prefetch_buffer:
+        return PrefetchingIter(it)
+    return it
+
+
+def ImageDetRecordIter(path_imgrec, data_shape, batch_size,
+                       mean_r=0.0, mean_g=0.0, mean_b=0.0, shuffle=False,
+                       num_parts=1, part_index=0, **kwargs):
+    """Detection RecordIO iterator (reference:
+    src/io/iter_image_det_recordio.cc:582)."""
+    mean = None
+    if mean_r or mean_g or mean_b:
+        mean = np.array([mean_r, mean_g, mean_b])
+    from .image.detection import ImageDetIter
+    return ImageDetIter(batch_size=batch_size, data_shape=data_shape,
+                        path_imgrec=path_imgrec, shuffle=shuffle,
+                        num_parts=num_parts, part_index=part_index,
+                        mean=mean, **kwargs)

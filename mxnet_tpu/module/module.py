@@ -277,16 +277,35 @@ class Module(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring...")
             return
+        # normalize the summed batch gradient like the reference
+        # (module.py:494-507: rescale_grad defaults to 1/batch_size,
+        # scaled by num_workers for dist kvstore)
+        batch_size = self._data_shapes[0][1][0] if self._data_shapes else 1
+        from .. import kvstore as kvs
+        kv_obj = None
+        if kvstore:
+            kv_obj = kvs.create(kvstore) if isinstance(kvstore, str) \
+                else kvstore
+            kv_type = getattr(kv_obj, "type", "")
+            if "dist" in kv_type and "_sync" in kv_type:
+                batch_size *= kv_obj.num_workers
+        rescale_grad = 1.0 / max(batch_size, 1)
         if isinstance(optimizer, str):
             idx2name = {i: n for i, n in enumerate(self._param_names)}
             optimizer_params = dict(optimizer_params)
+            optimizer_params.setdefault("rescale_grad", rescale_grad)
             optimizer = opt.create(optimizer, param_idx2name=idx2name,
                                    **optimizer_params)
+        elif optimizer.rescale_grad != rescale_grad:
+            self.logger.warning(
+                "Optimizer created manually outside Module but "
+                "rescale_grad is not normalized to 1.0/batch_size "
+                "(%s vs. %s). Is this intended?",
+                optimizer.rescale_grad, rescale_grad)
         self._optimizer = optimizer
         self._updater = opt.get_updater(optimizer)
-        from .. import kvstore as kvs
-        if kvstore:
-            kv = kvs.create(kvstore) if isinstance(kvstore, str) else kvstore
+        if kv_obj is not None:
+            kv = kv_obj
             self._kvstore = kv
             self._update_on_kvstore = kv.is_distributed
             if self._update_on_kvstore:

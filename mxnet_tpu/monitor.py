@@ -1,0 +1,111 @@
+"""Monitor: tap intermediate op outputs during training for debugging.
+
+TPU-native rebuild of ``mxnet.monitor`` (reference: python/mxnet/monitor.py:33
+``Monitor``). The reference registers a C callback on every executor that the
+engine invokes per op output (GraphExecutor::SetMonitorCallback
+graph_executor.cc:121, ExecuteMonCallback :1445); here the executor runs an
+interpreted capture pass when a monitor is installed, handing every node's
+output to the same (name, value) callback protocol.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Periodically inspect outputs/weights/gradients of a bound module.
+
+    Parameters mirror the reference (monitor.py:33): ``interval`` batches
+    between activations, ``stat_func`` maps an NDArray to a scalar stat
+    (default mean absolute value), ``pattern`` filters tapped names,
+    ``sort`` orders results by name.
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):
+                return x.abs().mean()
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+        def stat_helper(name, array):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            self.queue.append((self.step, name, self.stat_func(array)))
+
+        self.stat_helper = stat_helper
+
+    def install(self, exe, monitor_all=True):
+        """Attach to an executor (reference: monitor.py:87).
+
+        ``monitor_all=True`` taps every op output via the interpreted
+        capture pass; ``False`` taps only graph outputs (cheap, stays on
+        the jit path)."""
+        exe.set_monitor_callback(self.stat_helper, monitor_all=monitor_all)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this batch if the interval elapsed
+        (reference: monitor.py:94)."""
+        if self.step % self.interval == 0:
+            for exe in self.exes:
+                for array in exe.arg_arrays:
+                    array.wait_to_read()
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Stop collecting; returns [(step, name, stat_str)]
+        (reference: monitor.py:106)."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for array in exe.arg_arrays:
+                array.wait_to_read()
+        for exe in self.exes:
+            for name, array in zip(exe._symbol.list_arguments(),
+                                   exe.arg_arrays):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(array)))
+            for name, array in zip(exe._symbol.list_arguments(),
+                                   exe.grad_arrays):
+                if array is not None and self.re_prog.match(name + "_grad"):
+                    self.queue.append((self.step, name + "_grad",
+                                       self.stat_func(array)))
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            assert isinstance(v_list, list)
+            s = ""
+            for v in v_list:
+                assert isinstance(v, NDArray)
+                if v.shape == (1,) or v.shape == ():
+                    s += str(v.asnumpy().reshape(-1)[0]) + "\t"
+                else:
+                    s += str(v.asnumpy()) + "\t"
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """toc + log each stat (reference: monitor.py:139)."""
+        res = self.toc()
+        for n, k, v in res:
+            logging.info('Batch: %7d %30s %s', n, k, v)

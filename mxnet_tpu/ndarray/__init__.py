@@ -30,13 +30,56 @@ _CREATION_OPS = {"_zeros", "_ones", "_full", "_arange", "_eye", "_linspace",
                  "_random_generalized_negative_binomial"}
 
 
+def _arrayish(v):
+    return isinstance(v, (NDArray, np.ndarray, jnp.ndarray))
+
+
 def _make_op_func(opdef):
+    from ..symbol.op_info import op_input_names
+    _arg_names, _aux_names = op_input_names(opdef.name)
+    _names = list(_arg_names or ()) + list(_aux_names or ())
+
     def fn(*args, **kwargs):
         ctx = kwargs.pop("ctx", None)
+        out = kwargs.pop("out", None)
+        args = list(args)
+        # Trailing Nones are omitted optional inputs — safe to drop.
+        while args and args[-1] is None:
+            args.pop()
+        # Bind inputs by declared name so (a) a non-trailing None (e.g.
+        # CTCLoss(pred, label, None, label_lens)) never shifts later inputs
+        # left and (b) keyword-passed inputs (relu-style data=x) land in the
+        # positional slots the autograd tape records.
+        if _arg_names is not None and len(args) <= len(_names):
+            names = _names
+            vals = list(args) + [None] * (len(names) - len(args))
+            for i, n in enumerate(names):
+                if vals[i] is None and n in kwargs and \
+                        (kwargs[n] is None or _arrayish(kwargs[n])):
+                    vals[i] = kwargs.pop(n)
+            while vals and vals[-1] is None:
+                vals.pop()
+            if any(v is None for v in vals):
+                # inputs after a gap reach the op fn as keyword arrays;
+                # they bypass the tape, which is correct for the optional
+                # non-differentiable inputs (lengths, indices) this covers
+                prefix = 0
+                while prefix < len(vals) and vals[prefix] is not None:
+                    prefix += 1
+                for n, v in zip(names[prefix:], vals[prefix:]):
+                    if v is not None:
+                        kwargs[n] = v._data if isinstance(v, NDArray) \
+                            else jnp.asarray(v)
+                vals = vals[:prefix]
+            args = vals
+        elif any(a is None for a in args):
+            raise TypeError(
+                f"{opdef.name}: cannot bind a non-trailing None "
+                "positional input; pass optional inputs by keyword")
+        if out is not None:
+            kwargs["out"] = out
         nd_args = []
         for a in args:
-            if a is None:
-                continue  # optional trailing inputs (e.g. CTCLoss lengths)
             if isinstance(a, NDArray):
                 nd_args.append(a)
             elif isinstance(a, (list, tuple)) and a and isinstance(a[0], NDArray):

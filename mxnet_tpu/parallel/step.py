@@ -118,8 +118,13 @@ class TrainStep:
                  optimizer_params=None, mesh: Optional[Mesh] = None,
                  data_axis="data", compute_dtype=None, lr=0.01,
                  lr_schedule: Optional[Callable[[int], float]] = None,
-                 param_spec_fn=None):
+                 param_spec_fn=None, preprocess=None):
+        """``preprocess``: optional on-device fn applied to the data batch
+        inside the compiled step (e.g. uint8 decode -> normalize). Keeps the
+        host->device transfer small — the TPU analog of the reference doing
+        mean-subtract inside the C++ iterator (iter_normalize.h)."""
         self.net = net
+        self.preprocess = preprocess
         self.loss_fn = _LOSSES[loss] if isinstance(loss, str) else loss
         optimizer_params = dict(optimizer_params or {})
         self.lr = optimizer_params.pop("learning_rate", lr)
@@ -182,7 +187,12 @@ class TrainStep:
         compute_dtype = self.compute_dtype
         param_objs = self.param_list
 
+        preprocess = self.preprocess
+
         def step_fn(pvals, opt_state, x, y, key, lr):
+            if preprocess is not None:
+                x = preprocess(x)
+
             def fwd(pv):
                 pv_c = pv
                 if compute_dtype is not None:

@@ -52,6 +52,16 @@ def _symbol_op(op_name, sym_inputs, attrs, name=None, attr=None):
     return Symbol(node)
 
 
+# data-like inputs are never auto-created as variables; passing None for
+# one of them means "genuinely omitted" (optional inputs like lengths).
+# Weight-like inputs (bias/gamma/...) auto-create even when passed as None
+# — matching the reference, where None simply doesn't bind.
+_NEVER_AUTO_CREATE = frozenset((
+    "data", "lhs", "rhs", "indices", "index", "a", "condition", "x", "y",
+    "rois", "grid", "loc", "sequence_length", "data_lengths",
+    "label_lengths", "state_cell"))
+
+
 def _make_sym_func(opdef):
     arg_names, aux_names = op_input_names(opdef.name)
 
@@ -59,37 +69,49 @@ def _make_sym_func(opdef):
         name = kwargs.pop("name", None)
         attr = kwargs.pop("attr", None)
         sym_inputs = []
-        # positional symbols
-        pos = [a for a in args if isinstance(a, Symbol)]
-        non_sym = [a for a in args if not isinstance(a, Symbol)]
+        # positional symbols; None is a placeholder for an omitted optional
+        # input and must consume its input name (not shift later args left)
+        pos = [a for a in args if isinstance(a, Symbol) or a is None]
+        non_sym = [a for a in args if not (isinstance(a, Symbol) or a is None)]
         if non_sym and arg_names is None:
             pass  # variadic ops take only symbols positionally
         if arg_names is not None:
             # named-input protocol: collect from kwargs by input name, then
             # positionally; auto-create missing trailing weight inputs
             resolved = {}
+            omitted = set()
             for n in arg_names + aux_names:
                 if n in kwargs and isinstance(kwargs[n], Symbol):
                     resolved[n] = kwargs.pop(n)
+                elif n in kwargs and kwargs[n] is None:
+                    kwargs.pop(n)
+                    if n in _NEVER_AUTO_CREATE:
+                        omitted.add(n)  # explicit keyword omission
             it = iter(pos)
             for n in arg_names + aux_names:
                 if n not in resolved:
                     try:
-                        resolved[n] = next(it)
+                        nxt = next(it)
                     except StopIteration:
                         break
+                    if nxt is None:
+                        if n in _NEVER_AUTO_CREATE:
+                            omitted.add(n)
+                        # else: weight-like input, falls through to
+                        # auto-creation below
+                    else:
+                        resolved[n] = nxt
             opname = NameManager.current.get(name, opdef.name.lower())
             no_bias = kwargs.get("no_bias", False)
             full = []
             for n in arg_names + aux_names:
                 if n in resolved:
                     full.append((n, resolved[n]))
+                elif n in omitted:
+                    continue  # explicitly passed as None
                 elif n == "bias" and no_bias:
                     continue
-                elif n in ("data", "lhs", "rhs", "indices", "index",
-                           "a", "condition", "x", "y", "rois", "grid", "loc",
-                           "sequence_length", "data_lengths",
-                           "label_lengths", "state_cell"):
+                elif n in _NEVER_AUTO_CREATE:
                     continue  # data-like inputs are never auto-created
                 # NB: 'label' IS auto-created ({name}_label), matching the
                 # reference's softmax_label convention
@@ -99,9 +121,13 @@ def _make_sym_func(opdef):
                         v._node.attrs["__is_aux__"] = True
                     full.append((n, v))
             sym_inputs = [s for _, s in full]
-            return _symbol_op(opdef.name, sym_inputs,
-                              {k: v for k, v in kwargs.items()
-                               if v is not None},
+            node_attrs = {k: v for k, v in kwargs.items() if v is not None}
+            bound = [n for n, _ in full]
+            if bound != (arg_names + aux_names)[:len(bound)]:
+                # a middle input was omitted: record the names actually
+                # bound so eval binds by keyword, not position
+                node_attrs["__input_names__"] = bound
+            return _symbol_op(opdef.name, sym_inputs, node_attrs,
                               name=opname, attr=attr)
         # variadic / positional ops
         sym_inputs = pos

@@ -225,7 +225,13 @@ class Symbol:
         return _symbol_op(op_name, [self],
                           {k: v for k, v in attrs.items() if v is not None})
 
-    def reshape(self, shape, **kwargs):
+    def reshape(self, *shape, **kwargs):
+        # accepts reshape((2, 3)), reshape([2, 3]), reshape(2, 3) and
+        # reshape(shape=(2, 3)) like the reference fluent API
+        if "shape" in kwargs:
+            shape = kwargs.pop("shape")
+        elif len(shape) == 1:
+            shape = shape[0]
         if isinstance(shape, int):
             shape = (shape,)
         return self._unop("Reshape", shape=tuple(shape), **kwargs)
@@ -277,8 +283,12 @@ class Symbol:
         return outs
 
     def eval_arrays_ex(self, arg_arrays: Dict[str, "np.ndarray"],
-                      training=False, rng_key=None):
+                      training=False, rng_key=None, internals=None):
         """Evaluate; returns (outputs, aux_updates).
+
+        ``internals``: optional dict filled with every op node's outputs
+        keyed ``{node.name}_output`` — the Monitor tap point (reference:
+        GraphExecutor::SetMonitorCallback graph_executor.cc:121).
 
         ``training`` reaches training-aware ops (BatchNorm batch stats,
         Dropout active); each stochastic node draws a key folded from
@@ -315,10 +325,18 @@ class Symbol:
                 # so forward and backward see identical dropout masks
                 attrs["key"] = jax.random.fold_in(base,
                                                   node.uid % (2 ** 31))
-            res = opdef.fn(*ins, **attrs)
+            innames = node.attrs.get("__input_names__")
+            if innames:
+                res = opdef.fn(**dict(zip(parse_attr(innames), ins)),
+                               **attrs)
+            else:
+                res = opdef.fn(*ins, **attrs)
             outs = res if isinstance(res, tuple) else (res,)
             for i, o in enumerate(outs):
                 cache[(id(node), i)] = o
+                if internals is not None:
+                    suffix = "_output" if i == 0 else f"_output{i}"
+                    internals[node.name + suffix] = o
             if training and node.op in ("BatchNorm", "BatchNorm_v1") and \
                     not attrs.get("use_global_stats"):
                 momentum = attrs.get("momentum", 0.9)
@@ -408,8 +426,15 @@ class Symbol:
             try:
                 sds = [jax.ShapeDtypeStruct(s, np.float32)
                        for s in in_shapes]
-                out = jax.eval_shape(
-                    lambda *xs: opdef.fn(*xs, **attrs), *sds)
+                innames = node.attrs.get("__input_names__")
+                if innames:
+                    innames = parse_attr(innames)
+                    out = jax.eval_shape(
+                        lambda *xs: opdef.fn(**dict(zip(innames, xs)),
+                                             **attrs), *sds)
+                else:
+                    out = jax.eval_shape(
+                        lambda *xs: opdef.fn(*xs, **attrs), *sds)
             except Exception:
                 return
             outs = out if isinstance(out, tuple) else (out,)

@@ -100,3 +100,55 @@ def test_gluon_ctc_loss_tnc_with_lengths():
         mx.nd.array(t_lens), mx.nd.array(l_lens)).asnumpy()
     ref = _torch_ctc(pred, label, t_lens, l_lens, blank=C - 1)
     np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_ctc_label_lengths_only_positional_none():
+    """A non-trailing None must not shift later inputs left (advisor r2):
+    CTCLoss(pred, label, None, label_lengths) must bind label_lengths by
+    name, not to data_lengths."""
+    rng = np.random.RandomState(5)
+    T, N, C, L = 16, 3, 6, 5
+    data = rng.randn(T, N, C).astype(np.float32)
+    labels = rng.randint(0, C - 1, (N, L)).astype(np.float32)
+    l_lens = np.array([5, 2, 3], np.int32)
+    out = mx.nd.CTCLoss(
+        mx.nd.array(data), mx.nd.array(labels),
+        None, mx.nd.array(l_lens),
+        use_label_lengths=True, blank_label="last").asnumpy()
+    ref = _torch_ctc(data, labels, np.full((N,), T, np.int32), l_lens,
+                     blank=C - 1)
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_gluon_ctc_label_lengths_only():
+    from mxnet_tpu.gluon.loss import CTCLoss
+    rng = np.random.RandomState(6)
+    N, T, C, L = 3, 16, 6, 5
+    pred = rng.randn(N, T, C).astype(np.float32)
+    labels = rng.randint(0, C - 1, (N, L)).astype(np.float32)
+    l_lens = np.array([5, 2, 3], np.int32)
+    out = CTCLoss()(mx.nd.array(pred), mx.nd.array(labels),
+                    None, mx.nd.array(l_lens)).asnumpy()
+    ref = _torch_ctc(pred.transpose(1, 0, 2), labels,
+                     np.full((N,), T, np.int32), l_lens, blank=C - 1)
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_sym_ctc_label_lengths_only():
+    """Same misbinding guard through the symbolic path."""
+    import mxnet_tpu.symbol as sym
+    rng = np.random.RandomState(7)
+    T, N, C, L = 16, 3, 6, 5
+    data = rng.randn(T, N, C).astype(np.float32)
+    labels = rng.randint(0, C - 1, (N, L)).astype(np.float32)
+    l_lens = np.array([5, 2, 3], np.int32)
+    s = sym.CTCLoss(sym.var("data"), sym.var("label"), None,
+                    sym.var("llen"), use_label_lengths=True,
+                    blank_label="last")
+    ex = s.bind(mx.cpu(), {"data": mx.nd.array(data),
+                           "label": mx.nd.array(labels),
+                           "llen": mx.nd.array(l_lens)})
+    out = ex.forward()[0].asnumpy()
+    ref = _torch_ctc(data, labels, np.full((N,), T, np.int32), l_lens,
+                     blank=C - 1)
+    np.testing.assert_allclose(out, ref, atol=1e-4)
