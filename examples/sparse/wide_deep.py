@@ -1,0 +1,128 @@
+"""Wide & Deep learning with a sparse wide component (BASELINE config 5).
+
+TPU-native rebuild of the reference example
+(reference: example/sparse/wide_deep/train.py, model.py): the "wide" half is
+a linear model over one-hot categorical features stored as CSR whose weight
+receives a row_sparse gradient (lazy_update); the "deep" half is embeddings +
+an MLP trained densely through Gluon.
+
+Run: python wide_deep.py --num-epoch 5   (synthetic census-like data)
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import HybridBlock, nn
+from mxnet_tpu.ndarray import sparse
+
+
+N_FIELDS = 3          # categorical fields
+N_CATS = 50           # categories per field
+N_CONT = 8            # continuous features
+WIDE_DIM = N_FIELDS * N_CATS
+
+
+def make_synthetic(num_rows=2000, seed=0):
+    """Label depends on a sparse linear signal over the one-hot categoricals
+    plus a nonlinear function of the continuous features."""
+    rng = np.random.RandomState(seed)
+    cats = rng.randint(0, N_CATS, size=(num_rows, N_FIELDS))
+    cont = rng.randn(num_rows, N_CONT).astype(np.float32)
+    w_wide = rng.randn(WIDE_DIM)
+    offsets = np.arange(N_FIELDS) * N_CATS
+    wide_ids = cats + offsets  # (num_rows, N_FIELDS) global one-hot columns
+    signal = w_wide[wide_ids].sum(axis=1) + np.tanh(cont[:, :2]).sum(axis=1)
+    label = (signal > 0).astype(np.float32)
+    return cats.astype(np.int64), wide_ids.astype(np.int64), cont, label
+
+
+def batch_csr(wide_ids_batch):
+    """One-hot CSR for the wide part: one 1.0 per (row, field)."""
+    bsz = wide_ids_batch.shape[0]
+    indices = np.sort(wide_ids_batch, axis=1).reshape(-1)
+    indptr = np.arange(bsz + 1) * N_FIELDS
+    values = np.ones(bsz * N_FIELDS, np.float32)
+    return sparse.csr_matrix((values, indices, indptr), shape=(bsz, WIDE_DIM))
+
+
+class DeepNet(HybridBlock):
+    """Embeddings per categorical field + MLP over [embeddings, continuous]
+    (reference: wide_deep/model.py deep component)."""
+
+    def __init__(self, embed_dim=8, hidden=32, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.embeddings = []
+            for i in range(N_FIELDS):
+                emb = nn.Embedding(N_CATS, embed_dim)
+                setattr(self, f"embed{i}", emb)
+                self.embeddings.append(emb)
+            self.fc1 = nn.Dense(hidden, activation="relu")
+            self.fc2 = nn.Dense(1)
+
+    def forward(self, cats, cont):
+        embs = [emb(cats[:, i]) for i, emb in enumerate(self.embeddings)]
+        h = nd.concat(*embs, cont, dim=1)
+        return self.fc2(self.fc1(h))
+
+
+def train(num_epoch=5, batch_size=64, lr=0.02, wide_lr=0.2, log=print):
+    cats, wide_ids, cont, label = make_synthetic()
+    n = len(label)
+
+    deep = DeepNet()
+    deep.initialize(mx.init.Xavier())
+    trainer = mx.gluon.Trainer(deep.collect_params(), "adam",
+                               {"learning_rate": lr})
+
+    # the wide weight trains with lazy row-sparse adam updates
+    w_wide = nd.zeros((WIDE_DIM, 1))
+    wide_opt = mx.optimizer.Adam(learning_rate=wide_lr, lazy_update=True)
+    wide_state = wide_opt.create_state(0, w_wide)
+
+    acc = 0.0
+    for epoch in range(num_epoch):
+        order = np.random.permutation(n)
+        total_loss, correct = 0.0, 0
+        for lo in range(0, n - batch_size + 1, batch_size):
+            idx = order[lo:lo + batch_size]
+            csr = batch_csr(wide_ids[idx])
+            cat_nd = nd.array(cats[idx], dtype="int32")
+            cont_nd = nd.array(cont[idx])
+            y = nd.array(label[idx]).reshape((-1, 1))
+
+            w_wide.attach_grad(stype="row_sparse")
+            with mx.autograd.record():
+                wide_logit = sparse.dot(csr, w_wide)
+                deep_logit = deep(cat_nd, cont_nd)
+                logits = wide_logit + deep_logit
+                loss = (logits.relu() - logits * y +
+                        (1 + (-logits.abs()).exp()).log()).mean()
+            loss.backward()
+            trainer.step(1)
+            wide_opt.update(0, w_wide, w_wide.grad, wide_state)
+
+            pred = (logits.asnumpy() > 0).astype(np.float32)
+            correct += int((pred == label[idx].reshape(-1, 1)).sum())
+            total_loss += float(loss.asscalar())
+        nbatches = (n // batch_size)
+        acc = correct / (nbatches * batch_size)
+        log(f"epoch {epoch}: loss={total_loss / nbatches:.4f} "
+            f"accuracy={acc:.4f}")
+    return acc
+
+
+def main():
+    parser = argparse.ArgumentParser(description="wide & deep with sparse wide")
+    parser.add_argument("--num-epoch", type=int, default=5)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.02)
+    parser.add_argument("--wide-lr", type=float, default=0.2)
+    args = parser.parse_args()
+    train(args.num_epoch, args.batch_size, args.lr, args.wide_lr)
+
+
+if __name__ == "__main__":
+    main()

@@ -253,7 +253,34 @@ def _accumulate_leaf(leaf, g):
     req = getattr(leaf, "_grad_req", "write")
     if req == "null" or leaf._grad is None:
         return
+    if getattr(g, "stype", "default") == "row_sparse":
+        # sparse gradient (e.g. from sparse.dot): keep it sparse so the
+        # optimizer's lazy_update row-scatter path can run
+        # (reference: grad_stype='row_sparse', sparse.py / optimizer_op.cc)
+        from .ndarray.sparse import RowSparseNDArray, add as _rsp_add
+        accumulate = req == "add" or \
+            getattr(leaf, "_grad_written_seq", None) == _backward_seq[0]
+        prev = leaf._grad
+        if accumulate and isinstance(prev, RowSparseNDArray):
+            leaf._grad = _rsp_add(prev, g)
+        elif accumulate:
+            prev._data = prev._data + g.todense()._data
+        else:
+            leaf._grad = g.copy()
+            leaf._grad_written_seq = _backward_seq[0]
+        return
     g = jnp.asarray(g, leaf._grad.dtype)
+    if getattr(leaf._grad, "stype", "default") != "default":
+        # dense cotangent into a sparse grad buffer (e.g. the leaf also feeds
+        # a dense op like an L2 penalty): fall back to a dense grad — the
+        # reference's cast_storage fallback semantics
+        from .ndarray.ndarray import _wrap as _wrap_nd
+        accumulate = req == "add" or \
+            getattr(leaf, "_grad_written_seq", None) == _backward_seq[0]
+        prev = leaf._grad.todense()._data if accumulate else None
+        leaf._grad = _wrap_nd(g if prev is None else prev + g)
+        leaf._grad_written_seq = _backward_seq[0]
+        return
     if req == "add":
         leaf._grad._data = leaf._grad._data + g
     else:  # write — but within one backward pass multiple paths accumulate

@@ -419,6 +419,13 @@ def CSVIter(*args, **kwargs):
     return _CSVIter(*args, **kwargs)
 
 
+def LibSVMIter(*args, **kwargs):
+    """LibSVM iterator yielding CSR batches (reference:
+    src/io/iter_libsvm.cc:200)."""
+    from .io_native import LibSVMIter as _LibSVMIter
+    return _LibSVMIter(*args, **kwargs)
+
+
 def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
                     mean_r=0.0, mean_g=0.0, mean_b=0.0,
                     std_r=1.0, std_g=1.0, std_b=1.0,

@@ -162,12 +162,6 @@ class LibSVMIter(DataIter):
         self.cursor += self.batch_size
         return self.cursor < self.num_data
 
-    def _row_dense(self, i):
-        out = np.zeros(self.num_features, np.float32)
-        lo, hi = self._indptr[i], self._indptr[i + 1]
-        out[self._indices[lo:hi]] = self._values[lo:hi]
-        return out
-
     def next(self):
         if not self.iter_next():
             raise StopIteration
@@ -177,17 +171,25 @@ class LibSVMIter(DataIter):
         pad = self.batch_size - len(rows)
         if pad and self.round_batch:
             rows += list(range(pad))
-        data = np.stack([self._row_dense(i) for i in rows])
+        # build the CSR batch directly from the stored CSR slices —
+        # no densification (reference: iter_sparse_batchloader.h)
+        from .ndarray.sparse import csr_matrix
+        vals, inds, iptr = [], [], [0]
+        for i in rows:
+            rlo, rhi = int(self._indptr[i]), int(self._indptr[i + 1])
+            vals.append(self._values[rlo:rhi])
+            inds.append(self._indices[rlo:rhi])
+            iptr.append(iptr[-1] + (rhi - rlo))
         label = self._labels[rows]
         if pad and not self.round_batch:
-            # zero-pad to the promised batch shape (matches CSVIter)
-            data = np.concatenate(
-                [data, np.zeros((pad,) + data.shape[1:], data.dtype)])
+            # zero-pad to the promised batch shape (matches CSVIter):
+            # padded rows are empty in CSR
+            iptr.extend([iptr[-1]] * pad)
             label = np.concatenate(
                 [label, np.zeros((pad,) + label.shape[1:], label.dtype)])
-        try:
-            from .ndarray.sparse import csr_matrix
-            batch = csr_matrix(data)
-        except ImportError:
-            batch = nd.array(data)
+        batch = csr_matrix(
+            (np.concatenate(vals) if vals else np.zeros(0, np.float32),
+             np.concatenate(inds) if inds else np.zeros(0, np.int64),
+             np.asarray(iptr, np.int64)),
+            shape=(self.batch_size, self.num_features))
         return DataBatch([batch], [nd.array(label)], pad=pad)

@@ -31,6 +31,26 @@ from .ndarray.ndarray import NDArray
 __all__ = ["KVStore", "create"]
 
 
+def _copy_store_value(src, t):
+    """Copy a stored value into a pull target, converting storage type when
+    they differ (reference analog: cast_storage on pull)."""
+    src_stype = getattr(src, "stype", "default")
+    t_stype = getattr(t, "stype", "default")
+    if src_stype != t_stype:
+        src = src.todense() if src_stype != "default" else src
+        if t_stype == "default":
+            t._data = src._data
+            return
+        from .ndarray.sparse import dense_to_sparse
+        src = dense_to_sparse(src, t_stype)
+    t._data = src._data
+    if t_stype != "default":
+        t._indices = src._indices
+        t._sshape = src._sshape
+        if t_stype == "csr":
+            t._indptr = src._indptr
+
+
 def _as_key_list(key, value):
     """Normalize (key, value) to parallel lists (reference:
     python/mxnet/kvstore.py _ctype_key_value)."""
@@ -95,20 +115,58 @@ class KVStore:
             else:
                 src = self._data[k]
             for t in targets:
-                t._data = src._data
+                _copy_store_value(src, t)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Pull only the rows in row_ids (reference: kvstore.py:195-209;
-        sharded-embedding analog)."""
+        """Pull only the rows in row_ids (reference: kvstore.py:195-209,
+        native PullRowSparse_ kvstore_dist.h:259-288 — the sharded-embedding
+        path: only the rows a batch touches travel to the worker).
+
+        A ``RowSparseNDArray`` out receives (values, unique-sorted row ids);
+        a dense out receives the rows stacked in row_ids order."""
+        import jax.numpy as jnp
+        import numpy as np
+        from .ndarray.sparse import RowSparseNDArray
+
         assert row_ids is not None, "row_ids is required for row_sparse_pull"
         keys, outs = _as_key_list(key, out)
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        merged = getattr(self, "_merged", {})
         for k, o, r in zip(keys, outs, rids * (len(keys) // max(len(rids), 1) or 1)):
             targets = o if isinstance(o, (list, tuple)) else [o]
-            src = self._data[k]
-            rows = src.take(r, axis=0) if hasattr(src, "take") else src
+            if self._updater is None and k in merged:
+                src = merged[k]
+            else:
+                src = self._data[k]
+            r_np = r.asnumpy().astype(np.int64) if isinstance(r, NDArray) \
+                else np.asarray(r, np.int64)
+            uniq = np.unique(r_np)
+
+            def gather(rows):
+                # gather rows without densifying the whole table: a
+                # row_sparse store maps requested ids onto its stored rows
+                # (missing ids read as zero)
+                if isinstance(src, RowSparseNDArray):
+                    have = np.asarray(src._indices)
+                    pos = np.searchsorted(have, rows)
+                    pos_c = np.clip(pos, 0, max(len(have) - 1, 0))
+                    hit = (pos < len(have)) & (have[pos_c] == rows) \
+                        if len(have) else np.zeros(len(rows), bool)
+                    vals = src._data[pos_c] if len(have) else \
+                        jnp.zeros((len(rows),) + src._data.shape[1:],
+                                  src._data.dtype)
+                    return jnp.where(
+                        jnp.asarray(hit).reshape((-1,) + (1,) * (vals.ndim - 1)),
+                        vals, 0)
+                return src._data[rows]
+
             for t in targets:
-                t._data = rows._data
+                if isinstance(t, RowSparseNDArray):
+                    t._data = gather(uniq)
+                    t._indices = jnp.asarray(uniq, t._indices.dtype)
+                    t._sshape = tuple(src.shape)
+                else:
+                    t._data = gather(r_np.reshape(-1))
 
     # -- optimizer ------------------------------------------------------------
     def set_updater(self, updater):

@@ -185,3 +185,4 @@ def load(fname):
 
 
 from . import random  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401

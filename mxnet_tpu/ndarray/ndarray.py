@@ -153,7 +153,11 @@ class NDArray:
         MXAutogradMarkVariables semantics (attach_grad detaches)."""
         self._node = None
         self._node_index = 0
-        self._grad = _wrap(jnp.zeros(self.shape, self._data.dtype), self._ctx)
+        if stype is not None and stype != "default":
+            from .sparse import zeros as _sparse_zeros
+            self._grad = _sparse_zeros(stype, self.shape, dtype=self._data.dtype)
+        else:
+            self._grad = _wrap(jnp.zeros(self.shape, self._data.dtype), self._ctx)
         self._grad_req = grad_req
         self._require_grad = grad_req != "null"
 

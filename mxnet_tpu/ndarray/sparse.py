@@ -1,0 +1,452 @@
+"""Sparse NDArray storage types: ``row_sparse`` and ``csr``.
+
+TPU-native rebuild of the reference sparse frontend (reference:
+python/mxnet/ndarray/sparse.py, include/mxnet/ndarray.h:61-65 storage types).
+
+Design notes (TPU-first, not a port):
+- The reference keeps sparse data as (values + aux index arrays) on device and
+  dispatches FComputeEx kernels. Here the *structure* ops (union/intersect of
+  indices, conversion) run eagerly on host numpy — they are tiny and
+  data-dependent — while the *math* (sparse×dense dot, row scatter updates)
+  runs as static-shape XLA programs: nnz is fixed per array, so each distinct
+  nnz compiles once and then rides the jit cache.
+- ``csr`` dot dense maps to gather + ``segment_sum`` — both MXU/VPU friendly
+  and fusible by XLA; no dynamic shapes ever reach the compiled code.
+- ``row_sparse`` gradients flow through the autograd tape as first-class
+  objects; optimizers apply ``lazy_update`` row scatters (``.at[rows]``),
+  the analog of the reference's sparse sgd/adam kernels
+  (src/operator/optimizer_op.cc).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..context import Context
+from ..dtype import resolve_dtype
+from .ndarray import NDArray, _wrap
+
+__all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+           "csr_matrix", "row_sparse_array", "zeros", "empty", "array",
+           "dense_to_sparse", "retain", "dot", "add", "elemwise_add"]
+
+_ITYPE = jnp.int32  # index dtype; reference uses int64 (x64 is off under JAX)
+
+
+class BaseSparseNDArray(NDArray):
+    """Base for sparse storage types (reference: sparse.py:BaseSparseNDArray).
+
+    ``_data`` holds the *values* array; the full logical shape lives in
+    ``_sshape``. Dense-only NDArray methods are routed through ``todense()``.
+    """
+
+    __slots__ = ("_sshape",)
+
+    # -- to be provided by subclasses ---------------------------------------
+    @property
+    def stype(self):
+        raise NotImplementedError
+
+    def todense(self) -> NDArray:
+        raise NotImplementedError
+
+    # -- overrides ----------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._sshape)
+
+    @property
+    def size(self):
+        return int(np.prod(self._sshape)) if self._sshape else 1
+
+    @property
+    def ndim(self):
+        return len(self._sshape)
+
+    @property
+    def data(self):
+        """The values array (reference: sparse.py .data)."""
+        return _wrap(self._data)
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self.todense()
+        if stype == self.stype:
+            return self
+        return dense_to_sparse(self.todense(), stype)
+
+    def astype(self, dtype, copy=True):
+        out = self.copy()
+        out._data = self._data.astype(resolve_dtype(dtype))
+        return out
+
+    def __repr__(self):
+        return (f"\n<{type(self).__name__} "
+                f"{'x'.join(map(str, self.shape))} @{self.context}>")
+
+    def _dense_binop(self, other, op):
+        rhs = other.todense() if isinstance(other, BaseSparseNDArray) else other
+        return op(self.todense(), rhs)
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray) and isinstance(self, RowSparseNDArray):
+            return add(self, other)
+        return self._dense_binop(other, lambda a, b: a + b)
+
+    def __sub__(self, other):
+        return self._dense_binop(other, lambda a, b: a - b)
+
+    def __mul__(self, other):
+        if not isinstance(other, NDArray):  # scalar scales values directly
+            out = self.copy()
+            out._data = self._data * other
+            return out
+        return self._dense_binop(other, lambda a, b: a * b)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if not isinstance(other, NDArray):
+            out = self.copy()
+            out._data = self._data / other
+            return out
+        return self._dense_binop(other, lambda a, b: a / b)
+
+    def sum(self, axis=None, keepdims=False, exclude=False):
+        return self.todense().sum(axis=axis, keepdims=keepdims, exclude=exclude)
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return dot(self, other, transpose_a=transpose_a,
+                   transpose_b=transpose_b)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (reference: sparse.py:CSRNDArray).
+
+    ``_data``: (nnz,) values; ``_indices``: (nnz,) column ids;
+    ``_indptr``: (rows+1,) row pointers.
+    """
+
+    __slots__ = ("_indices", "_indptr")
+
+    def __init__(self, values, indices, indptr, shape, ctx=None):
+        super().__init__(jnp.asarray(values), ctx)
+        self._indices = jnp.asarray(indices, _ITYPE)
+        self._indptr = jnp.asarray(indptr, _ITYPE)
+        self._sshape = tuple(int(s) for s in shape)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indices(self):
+        return _wrap(self._indices)
+
+    @property
+    def indptr(self):
+        return _wrap(self._indptr)
+
+    def copy(self):
+        return CSRNDArray(self._data, self._indices, self._indptr,
+                          self._sshape, self._ctx)
+
+    def todense(self) -> NDArray:
+        n, d = self._sshape
+        nnz = int(self._data.shape[0])
+        if nnz == 0:
+            return _wrap(jnp.zeros(self._sshape, self._data.dtype), self._ctx)
+        rows = _csr_row_ids(self._indptr, nnz)
+        dense = jnp.zeros((n, d), self._data.dtype)
+        dense = dense.at[rows, self._indices].add(self._data)
+        return _wrap(dense, self._ctx)
+
+    def asscipy(self):
+        """Return a scipy.sparse.csr_matrix (reference: sparse.py:asscipy)."""
+        import scipy.sparse as sps
+        return sps.csr_matrix(
+            (np.asarray(self._data), np.asarray(self._indices),
+             np.asarray(self._indptr)), shape=self._sshape)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            start, stop, step = key.indices(self._sshape[0])
+            if step != 1:
+                raise ValueError("CSRNDArray slicing requires step 1")
+            iptr = np.asarray(self._indptr)
+            lo, hi = int(iptr[start]), int(iptr[stop])
+            return CSRNDArray(self._data[lo:hi], self._indices[lo:hi],
+                              self._indptr[start:stop + 1] - lo,
+                              (stop - start, self._sshape[1]), self._ctx)
+        return self.todense()[key]
+
+    def wait_to_read(self):
+        for a in (self._data, self._indices, self._indptr):
+            if isinstance(a, jax.Array):
+                a.block_until_ready()
+        return self
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse array: a subset of rows is stored (reference:
+    sparse.py:RowSparseNDArray; ndarray.h:61-65 kRowSparseStorage).
+
+    ``_data``: (nnz_rows, *row_shape) values; ``_indices``: (nnz_rows,)
+    sorted unique row ids.
+    """
+
+    __slots__ = ("_indices",)
+
+    def __init__(self, values, indices, shape, ctx=None):
+        super().__init__(jnp.asarray(values), ctx)
+        self._indices = jnp.asarray(indices, _ITYPE)
+        self._sshape = tuple(int(s) for s in shape)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self):
+        return _wrap(self._indices)
+
+    def copy(self):
+        return RowSparseNDArray(self._data, self._indices, self._sshape,
+                                self._ctx)
+
+    def todense(self) -> NDArray:
+        dense = jnp.zeros(self._sshape, self._data.dtype)
+        if int(self._indices.shape[0]):
+            dense = dense.at[self._indices].set(self._data)
+        return _wrap(dense, self._ctx)
+
+    def retain(self, row_ids):
+        return retain(self, row_ids)
+
+    def wait_to_read(self):
+        for a in (self._data, self._indices):
+            if isinstance(a, jax.Array):
+                a.block_until_ready()
+        return self
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+def csr_matrix(arg1, shape=None, ctx: Optional[Context] = None, dtype=None):
+    """Create a CSRNDArray from dense array-like, ``(data, indices, indptr)``,
+    a scipy csr matrix, or another sparse array (reference: sparse.py:csr_matrix).
+    """
+    dtype = resolve_dtype(dtype) if dtype is not None else None
+    if isinstance(arg1, CSRNDArray):
+        return arg1 if dtype is None else arg1.astype(dtype)
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = jnp.asarray(np.asarray(data), dtype)
+        if shape is None:
+            raise ValueError("shape is required for (data, indices, indptr)")
+        return CSRNDArray(data, np.asarray(indices), np.asarray(indptr),
+                          shape, ctx)
+    if hasattr(arg1, "tocsr"):  # scipy sparse
+        sp = arg1.tocsr()
+        return CSRNDArray(jnp.asarray(sp.data, dtype), sp.indices, sp.indptr,
+                          sp.shape, ctx)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
+    if dense.ndim != 2:
+        raise ValueError("csr_matrix requires a 2-D source")
+    if dtype is not None:
+        dense = dense.astype(dtype)
+    rows, cols = np.nonzero(dense)
+    indptr = np.zeros(dense.shape[0] + 1, np.int64)
+    np.add.at(indptr[1:], rows, 1)
+    indptr = np.cumsum(indptr)
+    return CSRNDArray(jnp.asarray(dense[rows, cols]), cols, indptr,
+                      dense.shape, ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx: Optional[Context] = None,
+                     dtype=None):
+    """Create a RowSparseNDArray from dense array-like or ``(data, indices)``
+    (reference: sparse.py:row_sparse_array)."""
+    dtype = resolve_dtype(dtype) if dtype is not None else None
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1 if dtype is None else arg1.astype(dtype)
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = data.asnumpy() if isinstance(data, NDArray) else np.asarray(data)
+        indices = indices.asnumpy() if isinstance(indices, NDArray) \
+            else np.asarray(indices)
+        order = np.argsort(indices)
+        data, indices = data[order], indices[order]
+        if shape is None:
+            shape = (int(indices.max()) + 1 if indices.size else 0,) \
+                + tuple(data.shape[1:])
+        return RowSparseNDArray(jnp.asarray(data, dtype), indices, shape, ctx)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
+    if dtype is not None:
+        dense = dense.astype(dtype)
+    nz_rows = np.nonzero(dense.reshape(dense.shape[0], -1).any(axis=1))[0]
+    return RowSparseNDArray(jnp.asarray(dense[nz_rows]), nz_rows,
+                            dense.shape, ctx)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    """All-zero sparse array (reference: sparse.py:zeros)."""
+    dtype = resolve_dtype(dtype)
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + shape[1:], dtype),
+                                jnp.zeros((0,), _ITYPE), shape, ctx)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype), jnp.zeros((0,), _ITYPE),
+                          jnp.zeros((shape[0] + 1,), _ITYPE), shape, ctx)
+    if stype == "default":
+        from . import zeros as _dzeros
+        return _dzeros(shape, ctx=ctx, dtype=dtype)
+    raise ValueError(f"unknown storage type {stype!r}")
+
+
+empty = zeros
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Sparse-aware array(): preserves the storage type of the source
+    (reference: sparse.py:array)."""
+    if isinstance(source_array, CSRNDArray) or hasattr(source_array, "tocsr"):
+        return csr_matrix(source_array, ctx=ctx, dtype=dtype)
+    if isinstance(source_array, RowSparseNDArray):
+        return row_sparse_array(source_array, ctx=ctx, dtype=dtype)
+    from . import array as _darray
+    return _darray(source_array, ctx=ctx, dtype=dtype)
+
+
+def dense_to_sparse(nd: NDArray, stype: str):
+    """Convert a dense NDArray (reference: tostype / cast_storage op)."""
+    if stype == "row_sparse":
+        return row_sparse_array(nd)
+    if stype == "csr":
+        return csr_matrix(nd)
+    if stype == "default":
+        return nd
+    raise ValueError(f"unknown storage type {stype!r}")
+
+
+# ---------------------------------------------------------------------------
+# structure ops
+# ---------------------------------------------------------------------------
+def retain(rsp: RowSparseNDArray, row_ids):
+    """Keep only the rows whose ids appear in ``row_ids`` (reference:
+    _retain op, sparse_retain-inl.h)."""
+    ids = row_ids.asnumpy() if isinstance(row_ids, NDArray) \
+        else np.asarray(row_ids)
+    have = np.asarray(rsp._indices)
+    mask = np.isin(have, ids)
+    keep = np.nonzero(mask)[0]
+    return RowSparseNDArray(rsp._data[keep], have[keep], rsp._sshape, rsp._ctx)
+
+
+def add(lhs: RowSparseNDArray, rhs: RowSparseNDArray) -> RowSparseNDArray:
+    """rsp + rsp -> rsp with union indices (reference: elemwise_add
+    FComputeEx for row_sparse)."""
+    li = np.asarray(lhs._indices)
+    ri = np.asarray(rhs._indices)
+    union = np.union1d(li, ri)
+    out = jnp.zeros((len(union),) + tuple(lhs._data.shape[1:]),
+                    jnp.result_type(lhs._data, rhs._data))
+    if li.size:
+        out = out.at[np.searchsorted(union, li)].add(lhs._data)
+    if ri.size:
+        out = out.at[np.searchsorted(union, ri)].add(rhs._data)
+    return RowSparseNDArray(out, union, lhs._sshape, lhs._ctx)
+
+
+elemwise_add = add
+
+
+def _csr_row_ids(indptr, nnz):
+    """Expand an indptr into per-value row ids — static-shape, jit-friendly
+    (searchsorted over the value positions)."""
+    return jnp.searchsorted(indptr, jnp.arange(nnz, dtype=_ITYPE),
+                            side="right").astype(_ITYPE) - 1
+
+
+@functools.partial(jax.jit, static_argnums=4)
+def _csr_dot_dense(values, indices, indptr, rhs, n_rows: int):
+    rows = _csr_row_ids(indptr, values.shape[0])
+    gathered = rhs[indices] * values[:, None]
+    return jax.ops.segment_sum(gathered, rows, num_segments=n_rows)
+
+
+@functools.partial(jax.jit, static_argnums=4)
+def _csr_t_dot_dense(values, indices, indptr, rhs, n_cols: int):
+    rows = _csr_row_ids(indptr, values.shape[0])
+    gathered = rhs[rows] * values[:, None]
+    return jax.ops.segment_sum(gathered, indices, num_segments=n_cols)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (reference: sparse.py:dot, src/operator/tensor/dot-inl.h).
+
+    csr × dense -> dense; csr.T × dense -> dense (recorded on the autograd
+    tape with a *row_sparse* gradient for the dense operand when
+    ``transpose_a`` is False — the sparse-training path the reference uses
+    for linear models over LibSVM features).
+    """
+    from .. import autograd
+
+    if isinstance(rhs, CSRNDArray) and not isinstance(lhs, CSRNDArray):
+        raise NotImplementedError("dense × csr is not supported; transpose")
+    if not isinstance(lhs, CSRNDArray):
+        return lhs.dot(rhs, transpose_a=transpose_a, transpose_b=transpose_b)
+    if transpose_b:
+        raise NotImplementedError("transpose_b with csr lhs")
+    if rhs.ndim != 2:
+        raise ValueError("csr dot requires 2-D rhs")
+    if isinstance(rhs, BaseSparseNDArray):
+        # csr × sparse: densify the rhs — its ``_data`` is a compacted
+        # values buffer, never valid to gather into directly
+        rhs = rhs.todense()
+
+    rhs_data = rhs._data
+    n, d = lhs._sshape
+    if transpose_a:
+        out_data = _csr_t_dot_dense(lhs._data, lhs._indices, lhs._indptr,
+                                    rhs_data, d)
+    else:
+        out_data = _csr_dot_dense(lhs._data, lhs._indices, lhs._indptr,
+                                  rhs_data, n)
+    out = _wrap(out_data, lhs._ctx)
+
+    if autograd.is_recording():
+        csr = lhs
+
+        if transpose_a:
+            def _vjp(cts):
+                ct = cts[0] if isinstance(cts, tuple) else cts
+                # d(csr.T @ w)/dw = csr @ ct (dense: every row of w is read)
+                return [_csr_dot_dense(csr._data, csr._indices, csr._indptr,
+                                       jnp.asarray(ct), csr._sshape[0])]
+        else:
+            def _vjp(cts):
+                ct = cts[0] if isinstance(cts, tuple) else cts
+                ct = ct if isinstance(ct, jnp.ndarray) else jnp.asarray(ct)
+                # d(csr @ w)/dw = csr.T @ ct — only columns present in the
+                # csr receive gradient, so emit a RowSparseNDArray over them.
+                touched = np.unique(np.asarray(csr._indices))
+                full = _csr_t_dot_dense(csr._data, csr._indices, csr._indptr,
+                                        ct, csr._sshape[1])
+                return [RowSparseNDArray(full[touched], touched,
+                                         (csr._sshape[1],) + tuple(ct.shape[1:]))]
+
+        node = autograd.TapeNode(_vjp, [rhs], 1, "sparse_dot")
+        out._node = node
+        out._node_index = 0
+        node.outputs = [out]
+    return out

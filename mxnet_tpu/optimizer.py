@@ -235,6 +235,27 @@ class SGD(Optimizer):
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
+        if getattr(grad, "stype", "default") == "row_sparse":
+            if not self.lazy_update:
+                grad = grad.todense()
+            else:
+                # lazy update: only rows present in the gradient are touched
+                # — wd and momentum included (reference: optimizer.py:433-530
+                # sgd lazy_update; src/operator/optimizer_op.cc sparse sgd)
+                import jax.numpy as jnp
+                rows = grad._indices
+                g = grad._data * self.rescale_grad
+                if self.clip_gradient is not None:
+                    g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+                w = weight._data
+                g = g + wd * w[rows]
+                if state is not None:
+                    m_rows = self.momentum * state._data[rows] - lr * g
+                    state._data = state._data.at[rows].set(m_rows)
+                    weight._data = w.at[rows].add(m_rows)
+                else:
+                    weight._data = w.at[rows].add(-lr * g)
+                return
         kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
                       clip_gradient=self.clip_gradient or -1.0)
         if state is not None:
@@ -411,6 +432,25 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2 ** t
         lr *= math.sqrt(coef2) / coef1
         mean, var = state
+        if getattr(grad, "stype", "default") == "row_sparse":
+            if not self.lazy_update:
+                grad = grad.todense()
+            else:
+                # lazy adam: moments and weight touched only at grad rows
+                # (reference: optimizer.py:778-839, adam_update sparse kernel)
+                import jax.numpy as jnp
+                rows = grad._indices
+                g = grad._data * self.rescale_grad
+                if self.clip_gradient is not None:
+                    g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+                g = g + wd * weight._data[rows]
+                m_rows = self.beta1 * mean._data[rows] + (1 - self.beta1) * g
+                v_rows = self.beta2 * var._data[rows] + (1 - self.beta2) * g * g
+                mean._data = mean._data.at[rows].set(m_rows)
+                var._data = var._data.at[rows].set(v_rows)
+                weight._data = weight._data.at[rows].add(
+                    -lr * m_rows / (jnp.sqrt(v_rows) + self.epsilon))
+                return
         w, m, v = _op("adam_update", weight, grad, mean, var, lr=lr,
                       beta1=self.beta1, beta2=self.beta2,
                       epsilon=self.epsilon, wd=wd,
@@ -435,6 +475,19 @@ class AdaGrad(Optimizer):
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
+        if getattr(grad, "stype", "default") == "row_sparse":
+            # sparse adagrad: history and weight touched only at grad rows
+            # (reference: optimizer.py:840-885 AdaGrad sparse support)
+            rows = grad._indices
+            g = grad._data * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            h_rows = state._data[rows] + g * g
+            state._data = state._data.at[rows].set(h_rows)
+            weight._data = weight._data.at[rows].add(
+                -lr * (g / jnp.sqrt(h_rows + self.float_stable_eps) +
+                       wd * weight._data[rows]))
+            return
         g = grad._data * self.rescale_grad
         if self.clip_gradient is not None:
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)

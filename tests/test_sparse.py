@@ -1,0 +1,319 @@
+"""Sparse NDArray tests.
+
+Mirrors the reference's tests/python/unittest/test_sparse_ndarray.py and
+test_sparse_operator.py strategy: numeric checks vs dense numpy references,
+plus the sparse optimizer lazy_update semantics
+(reference: src/operator/optimizer_op.cc sparse variants).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_csr_dense(n=8, d=16, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    dense = rng.randn(n, d).astype(np.float32)
+    dense[rng.rand(n, d) >= density] = 0.0
+    return dense
+
+
+class TestCreation:
+    def test_csr_from_dense_roundtrip(self):
+        dense = _rand_csr_dense()
+        csr = sparse.csr_matrix(dense)
+        assert csr.stype == "csr"
+        assert csr.shape == dense.shape
+        np.testing.assert_allclose(csr.asnumpy(), dense, rtol=1e-6)
+
+    def test_csr_from_tuple(self):
+        # 2x4: row0 = [0, 5, 0, 7], row1 = [0, 0, 3, 0]
+        csr = sparse.csr_matrix(([5.0, 7.0, 3.0], [1, 3, 2], [0, 2, 3]),
+                                shape=(2, 4))
+        expect = np.array([[0, 5, 0, 7], [0, 0, 3, 0]], np.float32)
+        np.testing.assert_allclose(csr.asnumpy(), expect)
+        np.testing.assert_array_equal(csr.indices.asnumpy(), [1, 3, 2])
+        np.testing.assert_array_equal(csr.indptr.asnumpy(), [0, 2, 3])
+
+    def test_csr_matches_scipy(self):
+        sps = pytest.importorskip("scipy.sparse")
+        dense = _rand_csr_dense()
+        ours = sparse.csr_matrix(dense)
+        ref = sps.csr_matrix(dense)
+        np.testing.assert_array_equal(ours.indices.asnumpy(), ref.indices)
+        np.testing.assert_array_equal(ours.indptr.asnumpy(), ref.indptr)
+        np.testing.assert_allclose(ours.data.asnumpy(), ref.data, rtol=1e-6)
+
+    def test_row_sparse_roundtrip(self):
+        dense = np.zeros((6, 3), np.float32)
+        dense[1] = [1, 2, 3]
+        dense[4] = [4, 5, 6]
+        rsp = sparse.row_sparse_array(dense)
+        assert rsp.stype == "row_sparse"
+        np.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 4])
+        np.testing.assert_allclose(rsp.asnumpy(), dense)
+
+    def test_row_sparse_from_tuple(self):
+        rsp = sparse.row_sparse_array(
+            ([[1.0, 2.0], [3.0, 4.0]], [3, 1]), shape=(5, 2))
+        expect = np.zeros((5, 2), np.float32)
+        expect[3] = [1, 2]
+        expect[1] = [3, 4]
+        np.testing.assert_allclose(rsp.asnumpy(), expect)
+        np.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 3])
+
+    def test_zeros_and_tostype(self):
+        z = sparse.zeros("row_sparse", (4, 3))
+        assert z.asnumpy().sum() == 0
+        z2 = sparse.zeros("csr", (4, 3))
+        assert z2.asnumpy().sum() == 0
+        dense = nd.array(_rand_csr_dense())
+        assert dense.tostype("csr").stype == "csr"
+        np.testing.assert_allclose(
+            dense.tostype("csr").tostype("default").asnumpy(),
+            dense.asnumpy(), rtol=1e-6)
+
+
+class TestOps:
+    def test_csr_dot_dense(self):
+        dense = _rand_csr_dense(6, 10)
+        w = np.random.RandomState(1).randn(10, 4).astype(np.float32)
+        csr = sparse.csr_matrix(dense)
+        out = sparse.dot(csr, nd.array(w))
+        np.testing.assert_allclose(out.asnumpy(), dense @ w,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_csr_t_dot_dense(self):
+        dense = _rand_csr_dense(6, 10)
+        rhs = np.random.RandomState(1).randn(6, 4).astype(np.float32)
+        csr = sparse.csr_matrix(dense)
+        out = sparse.dot(csr, nd.array(rhs), transpose_a=True)
+        np.testing.assert_allclose(out.asnumpy(), dense.T @ rhs,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_retain(self):
+        rsp = sparse.row_sparse_array(
+            ([[1.0], [2.0], [3.0]], [1, 3, 5]), shape=(7, 1))
+        kept = sparse.retain(rsp, [3, 5, 6])
+        np.testing.assert_array_equal(kept.indices.asnumpy(), [3, 5])
+        np.testing.assert_allclose(kept.data.asnumpy(), [[2.0], [3.0]])
+
+    def test_rsp_add(self):
+        a = sparse.row_sparse_array(([[1.0, 1.0]], [0]), shape=(3, 2))
+        b = sparse.row_sparse_array(([[2.0, 2.0], [3.0, 3.0]], [0, 2]),
+                                    shape=(3, 2))
+        c = a + b
+        assert c.stype == "row_sparse"
+        expect = np.array([[3, 3], [0, 0], [3, 3]], np.float32)
+        np.testing.assert_allclose(c.asnumpy(), expect)
+
+    def test_sparse_dense_mixed_arith(self):
+        dense = _rand_csr_dense()
+        csr = sparse.csr_matrix(dense)
+        other = np.ones_like(dense)
+        out = csr + nd.array(other)
+        np.testing.assert_allclose(out.asnumpy(), dense + other, rtol=1e-6)
+        scaled = csr * 2.0
+        assert scaled.stype == "csr"
+        np.testing.assert_allclose(scaled.asnumpy(), dense * 2, rtol=1e-6)
+
+
+class TestAutograd:
+    def test_sparse_dot_grad_is_row_sparse(self):
+        dense = np.array([[1.0, 0, 2.0, 0],
+                          [0, 0, 3.0, 0]], np.float32)   # cols 0, 2 touched
+        csr = sparse.csr_matrix(dense)
+        w = nd.array(np.random.RandomState(0).randn(4, 3).astype(np.float32))
+        w.attach_grad(stype="row_sparse")
+        with mx.autograd.record():
+            out = sparse.dot(csr, w)
+            loss = out.sum()
+        loss.backward()
+        assert w.grad.stype == "row_sparse"
+        np.testing.assert_array_equal(w.grad.indices.asnumpy(), [0, 2])
+        # analytic: d(sum(csr @ w))/dw = csr.T @ ones
+        expect = dense.T @ np.ones((2, 3), np.float32)
+        np.testing.assert_allclose(w.grad.asnumpy(), expect, rtol=1e-5)
+
+
+class TestAutogradEdgeCases:
+    def test_mixed_dense_sparse_grad_falls_back_dense(self):
+        # leaf feeds both a sparse dot and a dense op (L2 penalty): the
+        # sparse grad buffer must fall back to a correct dense gradient
+        dense = np.array([[1.0, 0, 2.0, 0]], np.float32)
+        csr = sparse.csr_matrix(dense)
+        w = nd.array(np.ones((4, 2), np.float32))
+        w.attach_grad(stype="row_sparse")
+        with mx.autograd.record():
+            loss = sparse.dot(csr, w).sum() + (w * w).sum()
+        loss.backward()
+        expect = dense.T @ np.ones((1, 2), np.float32) + 2 * np.ones((4, 2))
+        np.testing.assert_allclose(w.grad.asnumpy(), expect, rtol=1e-5)
+
+    def test_transpose_dot_grad(self):
+        dense = _rand_csr_dense(5, 7)
+        csr = sparse.csr_matrix(dense)
+        h = nd.array(np.random.RandomState(3).randn(5, 2).astype(np.float32))
+        h.attach_grad()
+        with mx.autograd.record():
+            out = sparse.dot(csr, h, transpose_a=True)  # (7, 2)
+            loss = (out * out).sum()
+        loss.backward()
+        # d/dh sum((A.T h)^2) = 2 A (A.T h)
+        expect = 2 * dense @ (dense.T @ h.asnumpy())
+        np.testing.assert_allclose(h.grad.asnumpy(), expect,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_dot_with_sparse_rhs_densifies(self):
+        lhs = sparse.csr_matrix(_rand_csr_dense(4, 6))
+        rhs = sparse.row_sparse_array(
+            ([[1.0, 2.0], [3.0, 4.0]], [1, 4]), shape=(6, 2))
+        out = sparse.dot(lhs, rhs)
+        np.testing.assert_allclose(out.asnumpy(),
+                                   lhs.asnumpy() @ rhs.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestSparseOptimizers:
+    def _run(self, opt_name, **opt_kwargs):
+        n, d = 10, 4
+        rng = np.random.RandomState(0)
+        w0 = rng.randn(n, d).astype(np.float32)
+        grad_rows = np.array([2, 7], np.int64)
+        gvals = rng.randn(2, d).astype(np.float32)
+
+        opt_sparse = mx.optimizer.create(opt_name, learning_rate=0.1,
+                                         wd=0.01, **opt_kwargs)
+        opt_dense = mx.optimizer.create(opt_name, learning_rate=0.1,
+                                        wd=0.01, **opt_kwargs)
+        w_s = nd.array(w0.copy())
+        w_d = nd.array(w0.copy())
+        state_s = opt_sparse.create_state(0, w_s)
+        state_d = opt_dense.create_state(0, w_d)
+
+        rsp = sparse.row_sparse_array((gvals, grad_rows), shape=(n, d))
+        opt_sparse.update(0, w_s, rsp, state_s)
+        opt_dense.update(0, w_d, rsp.todense(), state_d)
+
+        # touched rows match the dense update exactly
+        np.testing.assert_allclose(w_s.asnumpy()[grad_rows],
+                                   w_d.asnumpy()[grad_rows],
+                                   rtol=1e-5, atol=1e-6)
+        # untouched rows are NOT updated (lazy semantics: no wd decay applied)
+        untouched = [i for i in range(n) if i not in grad_rows]
+        np.testing.assert_array_equal(w_s.asnumpy()[untouched], w0[untouched])
+        # ...whereas the dense update decays every row (wd>0), so they differ
+        assert not np.allclose(w_d.asnumpy()[untouched], w0[untouched])
+
+    def test_sgd_lazy(self):
+        self._run("sgd")
+
+    def test_sgd_momentum_lazy(self):
+        self._run("sgd", momentum=0.9)
+
+    def test_adam_lazy(self):
+        self._run("adam")
+
+    def test_adagrad_lazy(self):
+        self._run("adagrad")
+
+
+class TestKVStoreSparse:
+    def test_row_sparse_pull(self):
+        kv = mx.kv.create("local")
+        w = nd.array(np.arange(12, dtype=np.float32).reshape(6, 2))
+        kv.init("emb", w)
+        out = sparse.zeros("row_sparse", (6, 2))
+        kv.row_sparse_pull("emb", out=out, row_ids=nd.array([4, 1, 4]))
+        np.testing.assert_array_equal(out.indices.asnumpy(), [1, 4])
+        np.testing.assert_allclose(out.asnumpy()[[1, 4]],
+                                   w.asnumpy()[[1, 4]])
+
+    def test_pull_dense_store_into_sparse_out(self):
+        kv = mx.kv.create("local")
+        w = nd.array(np.arange(8, dtype=np.float32).reshape(4, 2))
+        kv.init("w", w)
+        out = sparse.zeros("row_sparse", (4, 2))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), w.asnumpy())
+
+    def test_row_sparse_pull_sees_merged_push(self):
+        kv = mx.kv.create("local")  # no updater: push merges, pull reads it
+        kv.init("w", nd.zeros((4, 2)))
+        kv.push("w", nd.ones((4, 2)))
+        out = sparse.zeros("row_sparse", (4, 2))
+        kv.row_sparse_pull("w", out=out, row_ids=nd.array([1, 2]))
+        np.testing.assert_allclose(out.asnumpy()[[1, 2]],
+                                   np.ones((2, 2), np.float32))
+
+    def test_row_sparse_pull_from_sparse_store(self):
+        kv = mx.kv.create("local")
+        stored = sparse.row_sparse_array(
+            ([[1.0, 1.0], [2.0, 2.0]], [1, 3]), shape=(5, 2))
+        kv.init("emb", stored)
+        out = sparse.zeros("row_sparse", (5, 2))
+        kv.row_sparse_pull("emb", out=out, row_ids=nd.array([0, 1, 3]))
+        dense_out = out.asnumpy()
+        np.testing.assert_allclose(dense_out[0], [0, 0])
+        np.testing.assert_allclose(dense_out[1], [1, 1])
+        np.testing.assert_allclose(dense_out[3], [2, 2])
+
+    def test_push_sparse_grads_aggregates(self):
+        kv = mx.kv.create("local")
+        kv.init("w", nd.zeros((4, 2)))
+        a = sparse.row_sparse_array(([[1.0, 1.0]], [0]), shape=(4, 2))
+        b = sparse.row_sparse_array(([[2.0, 2.0]], [3]), shape=(4, 2))
+        kv.push("w", [a, b])
+        out = nd.zeros((4, 2))
+        kv.pull("w", out=out)
+        expect = np.zeros((4, 2), np.float32)
+        expect[0] = 1
+        expect[3] = 2
+        np.testing.assert_allclose(out.asnumpy(), expect)
+
+
+class TestExamples:
+    """Convergence of the sparse examples (reference:
+    example/sparse/linear_classification, wide_deep)."""
+
+    @staticmethod
+    def _load(name):
+        import importlib.util
+        import pathlib
+        path = (pathlib.Path(__file__).parent.parent / "examples" / "sparse"
+                / f"{name}.py")
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_linear_classification_converges(self, tmp_path):
+        mod = self._load("linear_classification")
+        data = tmp_path / "train.libsvm"
+        mod.make_synthetic_libsvm(str(data), num_rows=600, num_features=200,
+                                  nnz_per_row=8)
+        acc = mod.train(data_path=str(data), num_features=200, num_epoch=6,
+                        log=lambda *a: None)
+        assert acc > 0.85, f"sparse linear classification acc={acc}"
+
+    def test_wide_deep_converges(self):
+        mod = self._load("wide_deep")
+        acc = mod.train(num_epoch=3, log=lambda *a: None)
+        assert acc > 0.85, f"wide_deep acc={acc}"
+
+
+class TestLibSVMSparse:
+    def test_libsvm_iter_yields_csr(self, tmp_path):
+        p = tmp_path / "data.libsvm"
+        p.write_text("1 0:1.5 3:2.5\n0 1:3.0\n1 2:4.0 3:1.0\n0 0:2.0\n")
+        it = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(4,),
+                              batch_size=2)
+        batches = list(it)
+        assert len(batches) == 2
+        first = batches[0].data[0]
+        assert first.stype == "csr"
+        expect = np.array([[1.5, 0, 0, 2.5], [0, 3.0, 0, 0]], np.float32)
+        np.testing.assert_allclose(first.asnumpy(), expect)
+        np.testing.assert_allclose(batches[0].label[0].asnumpy(), [1, 0])
