@@ -8,6 +8,8 @@ kernel code is used.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -96,3 +98,332 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
     logp_n = jnp.transpose(logp, (1, 0, 2))  # (N, T, C)
     return jax.vmap(_ctc_one, in_axes=(0, 0, 0, 0, None))(
         logp_n, label, t_lens, l_lens, blank)
+
+
+# ---------------------------------------------------------------------------
+# SSD MultiBox family + box_nms
+# (reference: src/operator/contrib/multibox_prior.cc, multibox_target.cc,
+# multibox_detection.cc, bounding_box.cc. Re-derived as fixed-shape
+# vectorized lax: the reference's sequential CPU loops become masked argmax
+# scans / pairwise-IoU matrices that XLA can fuse; no dynamic shapes.)
+# ---------------------------------------------------------------------------
+def _tuplef(v, default):
+    """Attr coercion: tuples arrive as python sequences or MXNet-style
+    '(a,b)' strings (symbol JSON)."""
+    if v is None:
+        return tuple(default)
+    if isinstance(v, str):
+        v = v.strip("()[] ")
+        return tuple(float(x) for x in v.split(",") if x.strip())
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+def _box_iou(a, b):
+    """Pairwise IoU of corner-format boxes: (A,4) x (B,4) -> (A,B)
+    (reference: CalculateOverlap, multibox_target.cc)."""
+    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register_op("MultiBoxPrior", aliases=["_contrib_MultiBoxPrior"], no_grad=True)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5), **kw):
+    """Generate SSD anchor boxes from a feature map.
+
+    data: (N, C, H, W); output (1, H*W*K, 4) corner boxes, K = num_sizes - 1
+    + num_ratios, ordered [all sizes at ratio 1, then ratios[1:] at sizes[0]]
+    per location (reference: multibox_prior.cc:40-72 MultiBoxPriorForward).
+    """
+    sizes = _tuplef(sizes, (1.0,))
+    ratios = _tuplef(ratios, (1.0,))
+    steps = _tuplef(steps, (-1.0, -1.0))
+    offsets = _tuplef(offsets, (0.5, 0.5))
+    H, W = int(data.shape[2]), int(data.shape[3])
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+    # half-widths/heights per anchor kind; w carries the H/W aspect
+    # correction the reference applies (multibox_prior.cc:50,62)
+    ws = [s * H / W / 2 for s in sizes] + \
+         [sizes[0] * H / W * (r ** 0.5) / 2 for r in ratios[1:]]
+    hs = [s / 2 for s in sizes] + \
+         [sizes[0] / (r ** 0.5) / 2 for r in ratios[1:]]
+    w = jnp.asarray(ws, jnp.float32)
+    h = jnp.asarray(hs, jnp.float32)
+    cxg = jnp.broadcast_to(cx[None, :, None], (H, W, w.shape[0]))
+    cyg = jnp.broadcast_to(cy[:, None, None], (H, W, w.shape[0]))
+    boxes = jnp.stack([cxg - w, cyg - h, cxg + w, cyg + h], axis=-1)
+    boxes = boxes.reshape(1, H * W * w.shape[0], 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes.astype(data.dtype)
+
+
+def _encode_loc(anchors, gt):
+    """Box regression targets (reference: AssignLocTargets,
+    multibox_target.cc:32-55). Variances divided out by the caller."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    gw = gt[:, 2] - gt[:, 0]
+    gh = gt[:, 3] - gt[:, 1]
+    gx = (gt[:, 0] + gt[:, 2]) * 0.5
+    gy = (gt[:, 1] + gt[:, 3]) * 0.5
+    eps = 1e-12
+    return jnp.stack([
+        (gx - ax) / jnp.maximum(aw, eps),
+        (gy - ay) / jnp.maximum(ah, eps),
+        jnp.log(jnp.maximum(gw, eps) / jnp.maximum(aw, eps)),
+        jnp.log(jnp.maximum(gh, eps) / jnp.maximum(ah, eps)),
+    ], axis=1)
+
+
+def _multibox_target_one(anchors, label, cls_pred, overlap_threshold,
+                         ignore_label, negative_mining_ratio,
+                         negative_mining_thresh, minimum_negative_samples,
+                         variances):
+    """Single-sample anchor matching (reference: MultiBoxTargetForward,
+    multibox_target.cc:72-277). The sequential greedy bipartite match is a
+    fixed-length lax.scan (one round per ground-truth slot)."""
+    A = anchors.shape[0]
+    L = label.shape[0]
+    valid = label[:, 0] > -0.5
+    iou = _box_iou(anchors, label[:, 1:5])
+    iou = jnp.where(valid[None, :], iou, -1.0)
+
+    # stage 1: greedy global bipartite matching, at most L rounds
+    def bipartite_round(state, _):
+        a_used, g_used, m_gt, m_iou = state
+        masked = jnp.where(a_used[:, None] | g_used[None, :], -1.0, iou)
+        flat = jnp.argmax(masked)
+        ai, gi = flat // L, flat % L
+        ok = masked[ai, gi] > 1e-6
+        a_used = a_used.at[ai].set(a_used[ai] | ok)
+        g_used = g_used.at[gi].set(g_used[gi] | ok)
+        m_gt = m_gt.at[ai].set(jnp.where(ok, gi.astype(jnp.int32), m_gt[ai]))
+        m_iou = m_iou.at[ai].set(jnp.where(ok, masked[ai, gi], m_iou[ai]))
+        return (a_used, g_used, m_gt, m_iou), None
+
+    init = (jnp.zeros(A, bool), jnp.zeros(L, bool),
+            jnp.full(A, -1, jnp.int32), jnp.full(A, -1.0))
+    (matched, _, match_gt, match_iou), _ = jax.lax.scan(
+        bipartite_round, init, None, length=L)
+
+    # stage 2: per-anchor threshold matching for still-unmatched anchors
+    best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+    best_iou = jnp.max(iou, axis=1)
+    match_gt = jnp.where(matched, match_gt, best_gt)
+    match_iou = jnp.where(matched, match_iou, best_iou)
+    thr_pos = (~matched) & (best_iou > overlap_threshold) \
+        if overlap_threshold > 0 else jnp.zeros(A, bool)
+    positive = matched | thr_pos
+    num_pos = positive.sum()
+
+    # negatives: hard-negative mining by background prob, or everything
+    if negative_mining_ratio > 0:
+        prob = jax.nn.softmax(cls_pred, axis=0)[0]  # background prob (A,)
+        cand = (~positive) & (match_iou < negative_mining_thresh)
+        num_neg = jnp.minimum(
+            jnp.maximum((num_pos * negative_mining_ratio).astype(jnp.int32),
+                        int(minimum_negative_samples)),
+            A - num_pos)
+        score = jnp.where(cand, -prob, -jnp.inf)  # hardest = lowest bg prob
+        rank = jnp.argsort(jnp.argsort(-score))
+        negative = cand & (rank < num_neg)
+    else:
+        negative = ~positive
+
+    cls_of_gt = label[jnp.clip(match_gt, 0, L - 1), 0]
+    cls_target = jnp.where(positive, cls_of_gt + 1.0,
+                           jnp.where(negative, 0.0, float(ignore_label)))
+    gt_boxes = label[jnp.clip(match_gt, 0, L - 1), 1:5]
+    enc = _encode_loc(anchors, gt_boxes) / jnp.asarray(variances)
+    loc_target = jnp.where(positive[:, None], enc, 0.0).reshape(A * 4)
+    loc_mask = jnp.where(positive[:, None],
+                         jnp.ones((A, 4)), 0.0).reshape(A * 4)
+    return loc_target, loc_mask, cls_target
+
+
+@register_op("MultiBoxTarget", aliases=["_contrib_MultiBoxTarget"],
+             no_grad=True, num_outputs=3)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2), **kw):
+    """Compute SSD training targets.
+
+    anchor: (1, A, 4); label: (B, L, 5+) rows [cls, xmin, ymin, xmax, ymax],
+    -1-padded; cls_pred: (B, C, A). Returns (loc_target (B, A*4),
+    loc_mask (B, A*4), cls_target (B, A))
+    (reference: multibox_target.cc, multibox_target-inl.h:60-81).
+    """
+    variances = _tuplef(variances, (0.1, 0.1, 0.2, 0.2))
+    anchors = anchor.reshape(-1, 4)
+    # no_grad ops bypass the registry's per-(op,attrs) jit cache, so cache
+    # the jitted batch fn per attr-tuple here (re-tracing the bipartite scan
+    # per call would dominate the step)
+    fn = _mbt_jit(float(overlap_threshold), float(ignore_label),
+                  float(negative_mining_ratio), float(negative_mining_thresh),
+                  int(minimum_negative_samples), variances)
+    loc_t, loc_m, cls_t = fn(anchors, label, cls_pred)
+    return loc_t, loc_m, cls_t
+
+
+@functools.lru_cache(maxsize=None)
+def _mbt_jit(ot, il, nmr, nmt, mns, variances):
+    def batch(anchors, label, cls_pred):
+        one = lambda lb, cp: _multibox_target_one(
+            anchors, lb, cp, ot, il, nmr, nmt, mns, variances)
+        return jax.vmap(one)(label, cls_pred)
+    return jax.jit(batch)
+
+
+def _decode_boxes(anchors, loc_pred, variances, clip):
+    """Decode regression output to corner boxes (reference:
+    TransformLocations, multibox_detection.cc:46-71)."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    p = loc_pred.reshape(-1, 4)
+    ox = p[:, 0] * variances[0] * aw + ax
+    oy = p[:, 1] * variances[1] * ah + ay
+    ow = jnp.exp(p[:, 2] * variances[2]) * aw * 0.5
+    oh = jnp.exp(p[:, 3] * variances[3]) * ah * 0.5
+    boxes = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+def _greedy_nms_keep(boxes, ids, valid, nms_threshold, force_suppress):
+    """Greedy NMS over score-sorted boxes: returns keep mask.
+
+    The reference's O(N^2) sequential suppression (multibox_detection.cc:
+    152-167) as a fori_loop over a precomputed pairwise IoU matrix."""
+    N = boxes.shape[0]
+    iou = _box_iou(boxes, boxes)
+    same = jnp.ones((N, N), bool) if force_suppress \
+        else ids[:, None] == ids[None, :]
+    later = jnp.arange(N)[None, :] > jnp.arange(N)[:, None]
+    sup_mat = (iou >= nms_threshold) & same & later
+
+    def body(i, keep):
+        return keep & ~(keep[i] & sup_mat[i])
+
+    return jax.lax.fori_loop(0, N, body, valid)
+
+
+def _multibox_detection_one(cls_prob, loc_pred, anchors, threshold, clip,
+                            variances, nms_threshold, force_suppress,
+                            nms_topk):
+    A = cls_prob.shape[1]
+    fg = cls_prob[1:, :]                       # drop background row
+    cid = jnp.argmax(fg, axis=0).astype(jnp.float32)   # 0-based class id
+    score = jnp.max(fg, axis=0)
+    valid = score >= threshold
+    boxes = _decode_boxes(anchors, loc_pred, variances, clip)
+    order = jnp.argsort(-jnp.where(valid, score, -jnp.inf))
+    cid, score, boxes, valid = cid[order], score[order], boxes[order], valid[order]
+    if nms_topk > 0:
+        valid = valid & (jnp.arange(A) < nms_topk)
+    if 0 < nms_threshold <= 1:
+        keep = _greedy_nms_keep(boxes, cid, valid, nms_threshold,
+                                force_suppress)
+    else:
+        keep = valid
+    row = jnp.concatenate([cid[:, None], score[:, None], boxes], axis=1)
+    return jnp.where(keep[:, None], row, -1.0)
+
+
+@register_op("MultiBoxDetection", aliases=["_contrib_MultiBoxDetection"],
+             no_grad=True)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5,
+                       force_suppress=False, variances=(0.1, 0.1, 0.2, 0.2),
+                       nms_topk=-1, **kw):
+    """Decode predictions into detections with per-class NMS.
+
+    cls_prob: (B, C, A) softmax class probabilities (class 0 = background);
+    loc_pred: (B, A*4); anchor: (1, A, 4). Output (B, A, 6) rows
+    [class_id, score, xmin, ymin, xmax, ymax], suppressed/invalid rows -1
+    (reference: multibox_detection.cc:83-169, -inl.h:48-73).
+    """
+    variances = _tuplef(variances, (0.1, 0.1, 0.2, 0.2))
+    if int(background_id) != 0:
+        # the reference kernel also assumes class 0 is background (its
+        # scan starts at j=1, multibox_detection.cc:108) — reject rather
+        # than silently return wrong detections
+        raise NotImplementedError("MultiBoxDetection: background_id must "
+                                  "be 0 (class 0 is background)")
+    anchors = anchor.reshape(-1, 4)
+    fn = _mbd_jit(float(threshold), bool(clip), variances,
+                  float(nms_threshold), bool(force_suppress), int(nms_topk))
+    return fn(cls_prob, loc_pred, anchors)
+
+
+@functools.lru_cache(maxsize=None)
+def _mbd_jit(threshold, clip, variances, nms_threshold, force_suppress,
+             nms_topk):
+    def batch(cls_prob, loc_pred, anchors):
+        one = lambda cp, lp: _multibox_detection_one(
+            cp, lp, anchors, threshold, clip, variances, nms_threshold,
+            force_suppress, nms_topk)
+        return jax.vmap(one)(cls_prob, loc_pred)
+    return jax.jit(batch)
+
+
+@register_op("box_nms", aliases=["_contrib_box_nms", "box_non_maximum_suppression",
+                                 "_contrib_box_non_maximum_suppression"],
+             no_grad=True)
+def box_nms(data, overlap_thresh=0.5, topk=-1, coord_start=2, score_index=1,
+            id_index=-1, force_suppress=False, in_format="corner",
+            out_format="corner", valid_thresh=0.0, **kw):
+    """Generic non-maximum suppression over (..., N, K) box records
+    (reference: bounding_box.cc box_nms, bounding_box-inl.h:50-86).
+
+    Entries are sorted by descending score; suppressed/invalid entries are
+    set to -1. Boxes with score <= valid_thresh are invalid.
+    """
+    shape = data.shape
+    N, K = shape[-2], shape[-1]
+    flat = data.reshape((-1, N, K))
+    cs, si = int(coord_start), int(score_index)
+
+    def one(d):
+        score = d[:, si]
+        valid = score > valid_thresh
+        boxes = d[:, cs:cs + 4]
+        if in_format == "center":
+            cxy, wh = boxes[:, :2], boxes[:, 2:]
+            boxes = jnp.concatenate([cxy - wh / 2, cxy + wh / 2], axis=1)
+        ids = d[:, int(id_index)] if int(id_index) >= 0 \
+            else jnp.zeros(N, d.dtype)
+        order = jnp.argsort(-jnp.where(valid, score, -jnp.inf))
+        d_s, boxes_s, ids_s = d[order], boxes[order], ids[order]
+        valid_s, score_s = valid[order], score[order]
+        if topk > 0:
+            valid_s = valid_s & (jnp.arange(N) < int(topk))
+        keep = _greedy_nms_keep(boxes_s, ids_s, valid_s,
+                                float(overlap_thresh),
+                                bool(force_suppress) or int(id_index) < 0)
+        out = d_s
+        if out_format == "center" and in_format == "corner":
+            b = d_s[:, cs:cs + 4]
+            out = out.at[:, cs:cs + 4].set(jnp.concatenate(
+                [(b[:, :2] + b[:, 2:]) / 2, b[:, 2:] - b[:, :2]], axis=1))
+        elif out_format == "corner" and in_format == "center":
+            out = out.at[:, cs:cs + 4].set(boxes_s)
+        return jnp.where(keep[:, None], out, -1.0)
+
+    return jax.vmap(one)(flat).reshape(shape)

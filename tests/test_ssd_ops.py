@@ -1,0 +1,216 @@
+"""SSD MultiBox op tests vs hand-computed anchors/IoU/encodings.
+
+Mirrors the reference's test_operator.py multibox coverage
+(reference: src/operator/contrib/multibox_{prior,target,detection}.cc,
+bounding_box.cc).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+class TestMultiBoxPrior:
+    def test_anchor_layout_and_values(self):
+        # 2x2 feature map, sizes=(0.5,), ratios=(1,): 1 anchor/location
+        x = nd.zeros((1, 3, 2, 2))
+        out = nd.MultiBoxPrior(x, sizes=(0.5,), ratios=(1.0,))
+        assert out.shape == (1, 4, 4)
+        a = out.asnumpy()[0]
+        # location (0,0): center (0.25, 0.25), half-extent 0.25
+        np.testing.assert_allclose(a[0], [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+        # location (0,1): center x = 0.75
+        np.testing.assert_allclose(a[1], [0.5, 0.0, 1.0, 0.5], atol=1e-6)
+        # location (1,0): center y = 0.75
+        np.testing.assert_allclose(a[2], [0.0, 0.5, 0.5, 1.0], atol=1e-6)
+
+    def test_aspect_correction_and_count(self):
+        # non-square map: w gets the H/W correction (reference
+        # multibox_prior.cc:50) — K = num_sizes - 1 + num_ratios
+        x = nd.zeros((1, 3, 2, 4))
+        out = nd.MultiBoxPrior(x, sizes=(0.4, 0.2), ratios=(1.0, 2.0))
+        assert out.shape == (1, 2 * 4 * 3, 4)
+        a = out.asnumpy()[0]
+        # first anchor at (0,0): center (0.125, 0.25); w=0.4*2/4/2=0.1, h=0.2
+        np.testing.assert_allclose(a[0], [0.025, 0.05, 0.225, 0.45],
+                                   atol=1e-6)
+        # ratio-2 anchor: w=0.4*(2/4)*sqrt(2)/2, h=0.4/sqrt(2)/2
+        w = 0.4 * 0.5 * np.sqrt(2) / 2
+        h = 0.4 / np.sqrt(2) / 2
+        np.testing.assert_allclose(
+            a[2], [0.125 - w, 0.25 - h, 0.125 + w, 0.25 + h], atol=1e-6)
+
+    def test_clip(self):
+        x = nd.zeros((1, 3, 1, 1))
+        out = nd.MultiBoxPrior(x, sizes=(2.0,), ratios=(1.0,), clip=True)
+        a = out.asnumpy()[0, 0]
+        assert a.min() >= 0.0 and a.max() <= 1.0
+
+
+class TestMultiBoxTarget:
+    def _setup(self):
+        # two anchors: one overlapping the gt well, one far away
+        anchors = np.array([[[0.1, 0.1, 0.5, 0.5],
+                             [0.6, 0.6, 0.9, 0.9],
+                             [0.0, 0.0, 0.05, 0.05]]], np.float32)
+        # one gt: class 2, box overlapping anchor 0
+        label = np.array([[[2, 0.1, 0.1, 0.45, 0.45],
+                           [-1, -1, -1, -1, -1]]], np.float32)
+        cls_pred = np.zeros((1, 4, 3), np.float32)  # 4 classes (incl bg)
+        return nd.array(anchors), nd.array(label), nd.array(cls_pred)
+
+    def test_matching_and_cls_target(self):
+        anchors, label, cls_pred = self._setup()
+        loc_t, loc_m, cls_t = nd.MultiBoxTarget(anchors, label, cls_pred)
+        ct = cls_t.asnumpy()[0]
+        assert ct[0] == 3.0          # gt class 2 + 1 (0 = background)
+        assert ct[1] == 0.0          # negative (no mining -> all negatives)
+        assert ct[2] == 0.0
+        lm = loc_m.asnumpy()[0].reshape(3, 4)
+        np.testing.assert_array_equal(lm[0], [1, 1, 1, 1])
+        np.testing.assert_array_equal(lm[1], [0, 0, 0, 0])
+
+    def test_loc_encoding(self):
+        anchors, label, cls_pred = self._setup()
+        loc_t, _, _ = nd.MultiBoxTarget(anchors, label, cls_pred)
+        enc = loc_t.asnumpy()[0].reshape(3, 4)[0]
+        # hand-computed (reference AssignLocTargets): anchor (0.1,0.1,0.5,0.5)
+        # aw=ah=0.4 ax=ay=0.3; gt (0.1,0.1,0.45,0.45) gw=gh=0.35 gx=gy=0.275
+        vx, vy, vw, vh = 0.1, 0.1, 0.2, 0.2
+        np.testing.assert_allclose(enc[0], (0.275 - 0.3) / 0.4 / vx, rtol=1e-4)
+        np.testing.assert_allclose(enc[1], (0.275 - 0.3) / 0.4 / vy, rtol=1e-4)
+        np.testing.assert_allclose(enc[2], np.log(0.35 / 0.4) / vw, rtol=1e-4)
+        np.testing.assert_allclose(enc[3], np.log(0.35 / 0.4) / vh, rtol=1e-4)
+
+    def test_ignore_label_with_mining(self):
+        anchors, label, cls_pred = self._setup()
+        # mining with ratio 1 -> 1 negative picked, the rest ignored (-1)
+        _, _, cls_t = nd.MultiBoxTarget(
+            anchors, label, cls_pred, negative_mining_ratio=1.0,
+            negative_mining_thresh=0.5)
+        ct = cls_t.asnumpy()[0]
+        assert ct[0] == 3.0
+        assert sorted(ct[1:].tolist()) == [-1.0, 0.0]
+
+    def test_no_gt_all_background(self):
+        anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5]]], np.float32))
+        label = nd.array(np.full((1, 2, 5), -1.0, np.float32))
+        cls_pred = nd.zeros((1, 3, 1))
+        loc_t, loc_m, cls_t = nd.MultiBoxTarget(anchors, label, cls_pred)
+        assert cls_t.asnumpy()[0, 0] == 0.0
+        assert loc_m.asnumpy().sum() == 0.0
+
+
+class TestMultiBoxDetection:
+    def test_decode_identity(self):
+        # zero loc_pred decodes to the anchor box itself
+        anchors = np.array([[[0.2, 0.2, 0.6, 0.6]]], np.float32)
+        cls_prob = np.array([[[0.1], [0.9]]], np.float32)  # (1, 2, 1)
+        loc_pred = np.zeros((1, 4), np.float32)
+        out = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                                   nd.array(anchors))
+        row = out.asnumpy()[0, 0]
+        assert row[0] == 0.0                 # class 0 (background removed)
+        np.testing.assert_allclose(row[1], 0.9, rtol=1e-6)
+        np.testing.assert_allclose(row[2:], [0.2, 0.2, 0.6, 0.6], atol=1e-6)
+
+    def test_decode_shift(self):
+        # px=1, variance 0.1, aw=0.4 -> center shifts by 0.04
+        anchors = np.array([[[0.2, 0.2, 0.6, 0.6]]], np.float32)
+        cls_prob = np.array([[[0.1], [0.9]]], np.float32)
+        loc_pred = np.array([[1.0, 0.0, 0.0, 0.0]], np.float32)
+        out = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                                   nd.array(anchors))
+        row = out.asnumpy()[0, 0]
+        np.testing.assert_allclose(row[2:], [0.24, 0.2, 0.64, 0.6], atol=1e-6)
+
+    def test_threshold_filters(self):
+        anchors = np.array([[[0.2, 0.2, 0.6, 0.6]]], np.float32)
+        cls_prob = np.array([[[0.995], [0.005]]], np.float32)
+        loc_pred = np.zeros((1, 4), np.float32)
+        out = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                                   nd.array(anchors), threshold=0.01)
+        assert (out.asnumpy()[0, 0] == -1).all()
+
+    def test_nms_suppresses_same_class(self):
+        # two near-identical boxes, same argmax class: weaker one suppressed
+        anchors = np.array([[[0.2, 0.2, 0.6, 0.6],
+                             [0.21, 0.21, 0.61, 0.61]]], np.float32)
+        cls_prob = np.array([[[0.1, 0.3], [0.9, 0.7]]], np.float32)
+        loc_pred = np.zeros((1, 8), np.float32)
+        out = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                                   nd.array(anchors), nms_threshold=0.5)
+        rows = out.asnumpy()[0]
+        assert rows[0, 1] == 0.9             # strongest kept, sorted first
+        assert (rows[1] == -1).all()         # weaker overlapping suppressed
+
+    def test_nms_keeps_different_class(self):
+        anchors = np.array([[[0.2, 0.2, 0.6, 0.6],
+                             [0.21, 0.21, 0.61, 0.61]]], np.float32)
+        # different argmax classes, force_suppress off -> both kept
+        cls_prob = np.array([[[0.1, 0.3], [0.9, 0.0], [0.0, 0.7]]],
+                            np.float32)
+        loc_pred = np.zeros((1, 8), np.float32)
+        out = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                                   nd.array(anchors), nms_threshold=0.5)
+        rows = out.asnumpy()[0]
+        assert rows[0, 1] == 0.9 and rows[1, 1] == 0.7
+
+
+class TestBoxNMS:
+    def test_basic_suppression(self):
+        # records [id, score, x1, y1, x2, y2]
+        data = np.array([[0, 0.9, 0.1, 0.1, 0.5, 0.5],
+                         [0, 0.8, 0.12, 0.12, 0.52, 0.52],
+                         [0, 0.7, 0.7, 0.7, 0.9, 0.9]], np.float32)
+        out = nd.box_nms(nd.array(data), overlap_thresh=0.5, id_index=0)
+        a = out.asnumpy()
+        assert a[0, 1] == 0.9
+        assert (a[1] == -1).all()            # overlapping weaker suppressed
+        assert a[2, 1] == 0.7                # disjoint kept
+
+    def test_id_index_class_aware(self):
+        data = np.array([[0, 0.9, 0.1, 0.1, 0.5, 0.5],
+                         [1, 0.8, 0.12, 0.12, 0.52, 0.52]], np.float32)
+        out = nd.box_nms(nd.array(data), overlap_thresh=0.5, id_index=0)
+        a = out.asnumpy()
+        assert a[0, 1] == 0.9 and a[1, 1] == 0.8  # different class: both kept
+        out2 = nd.box_nms(nd.array(data), overlap_thresh=0.5, id_index=0,
+                          force_suppress=True)
+        assert (out2.asnumpy()[1] == -1).all()
+
+    def test_batch_and_topk(self):
+        data = np.stack([np.array([[0.9, 0.1, 0.1, 0.5, 0.5],
+                                   [0.8, 0.6, 0.6, 0.9, 0.9],
+                                   [0.7, 0.3, 0.3, 0.4, 0.4]], np.float32)] * 2)
+        out = nd.box_nms(nd.array(data), overlap_thresh=0.5, coord_start=1,
+                         score_index=0, topk=2)
+        a = out.asnumpy()
+        for b in range(2):
+            assert a[b, 0, 0] == 0.9 and a[b, 1, 0] == 0.8
+            assert (a[b, 2] == -1).all()     # beyond topk dropped
+
+    def test_center_format(self):
+        data = np.array([[0.9, 0.3, 0.3, 0.4, 0.4],    # center (0.3,0.3) wh 0.4
+                         [0.8, 0.3, 0.3, 0.38, 0.38]], np.float32)
+        out = nd.box_nms(nd.array(data), overlap_thresh=0.5, coord_start=1,
+                         score_index=0, in_format="center",
+                         out_format="corner")
+        a = out.asnumpy()
+        np.testing.assert_allclose(a[0, 1:], [0.1, 0.1, 0.5, 0.5], atol=1e-6)
+        assert (a[1] == -1).all()
+
+
+class TestSSDExample:
+    def test_ssd_example_converges(self):
+        import importlib.util
+        import pathlib
+        path = (pathlib.Path(__file__).parent.parent / "examples" / "ssd"
+                / "train.py")
+        spec = importlib.util.spec_from_file_location("ssd_train", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        iou, acc = mod.train(num_epoch=2, steps_per_epoch=40,
+                             log=lambda *a: None)
+        assert iou > 0.5, f"SSD mean IoU {iou}"
+        assert acc > 0.8, f"SSD class accuracy {acc}"
