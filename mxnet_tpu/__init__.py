@@ -49,6 +49,7 @@ from . import module
 from . import module as mod
 from . import monitor
 from . import monitor as mon
+from . import profiler
 from . import gluon
 from . import rnn
 from . import parallel

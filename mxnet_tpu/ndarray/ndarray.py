@@ -560,7 +560,19 @@ def _get_op_callable(opdef, attrs):
     return fn
 
 
+# dispatch hook: the profiler installs a timing wrapper here; checking it
+# inside _invoke_op covers every binding of the name (methods, generated
+# module functions, random.py) without monkey-patching each importer
+_PROFILE_HOOK = None
+
+
 def _invoke_op(name, nd_inputs, attrs):
+    if _PROFILE_HOOK is not None:
+        return _PROFILE_HOOK(_invoke_op_impl, name, nd_inputs, attrs)
+    return _invoke_op_impl(name, nd_inputs, attrs)
+
+
+def _invoke_op_impl(name, nd_inputs, attrs):
     opdef = get_op(name)
     attrs = {k: v for k, v in attrs.items() if v is not None or k in ("axis", "axes", "a_min", "a_max")}
     out = attrs.pop("out", None)

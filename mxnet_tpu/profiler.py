@@ -1,0 +1,321 @@
+"""Profiler facade.
+
+TPU-native rebuild of ``mxnet.profiler`` (reference:
+python/mxnet/profiler.py:28-400; native src/profiler/profiler.h:256,
+aggregate_stats.cc). Two layers:
+
+- **Device tracing** rides ``jax.profiler``: ``set_state('run')`` starts an
+  XLA/XPlane trace into the configured directory (viewable in TensorBoard
+  or Perfetto), the analog of the reference's chrome://tracing JSON dump.
+- **Host-side op aggregation**: the reference's "aggregate stats" table
+  (operator name → count, total/min/max ms) is reproduced by timing the
+  imperative op dispatch layer. It times host-visible dispatch+sync, not
+  per-kernel device time (XLA fuses ops; per-fused-kernel timing lives in
+  the trace above).
+
+Also provides the Domain/Task/Frame/Event/Counter/Marker object API
+(reference: profiler.py:151-400) mapped onto jax.profiler traces or
+host-side records.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import tempfile
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+__all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
+           "state", "Domain", "Task", "Frame", "Event", "Counter", "Marker"]
+
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": False,
+    "profile_imperative": False,
+    "profile_memory": False,
+    "profile_api": False,
+    "aggregate_stats": False,
+}
+_state = "stop"
+_trace_dir: Optional[str] = None
+_jax_trace_active = False
+
+# aggregate table: name -> [count, total_s, min_s, max_s]
+_agg: Dict[str, List[float]] = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
+_paused = False
+
+
+def set_config(**kwargs):
+    """Configure the profiler (reference: profiler.py:28-59). Recognized
+    keys: filename (trace output dir/file), profile_all, profile_symbolic,
+    profile_imperative, profile_memory, profile_api, aggregate_stats."""
+    for k, v in kwargs.items():
+        if k not in _config:
+            raise ValueError(f"unknown profiler config key {k!r}")
+        _config[k] = v
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """Deprecated alias (reference: profiler.py:60)."""
+    set_config(filename=filename,
+               profile_symbolic="symbolic" in (mode, "all"),
+               profile_all=mode == "all")
+
+
+def state():
+    return _state
+
+
+def set_state(state="stop"):
+    """Start/stop profiling (reference: profiler.py:79-91).
+
+    'run' starts a jax.profiler trace (device + host timeline) and turns on
+    host-side op aggregation when aggregate_stats is configured."""
+    global _state, _trace_dir, _jax_trace_active
+    if state not in ("run", "stop"):
+        raise ValueError("state must be 'run' or 'stop'")
+    if state == _state:
+        return
+    if state == "run":
+        base = _config["filename"]
+        # the reference writes one JSON file; jax.profiler wants a directory
+        _trace_dir = base if not base.endswith(".json") else \
+            base[:-len(".json")] + "_trace"
+        os.makedirs(_trace_dir, exist_ok=True)
+        try:
+            import jax
+            jax.profiler.start_trace(_trace_dir)
+            _jax_trace_active = True
+        except Exception:
+            _jax_trace_active = False  # e.g. a trace is already running
+        _install_op_timer()
+    else:
+        if _jax_trace_active:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                _jax_trace_active = False
+        _uninstall_op_timer()
+    _state = state
+
+
+def profiler_set_state(state="stop"):
+    """Deprecated alias (reference: profiler.py:92)."""
+    set_state(state)
+
+
+def pause():
+    """Suspend aggregation inside a run (reference: profiler.py:141)."""
+    global _paused
+    _paused = True
+
+
+def resume():
+    global _paused
+    _paused = False
+
+
+def dump(finished=True):
+    """Stop tracing and flush (reference: profiler.py:105-118). The XPlane
+    trace is written when the jax trace stops; the aggregate table is
+    returned by ``dumps()``."""
+    if _state == "run" and finished:
+        set_state("stop")
+
+
+def dump_profile():
+    """Deprecated alias (reference: profiler.py:119)."""
+    dump(True)
+
+
+def dumps(reset=False, format="table"):
+    """Return aggregate operator stats (reference: profiler.py:127-140;
+    native aggregate_stats.cc table)."""
+    rows = sorted(_agg.items(), key=lambda kv: -kv[1][1])
+    if format == "json":
+        out = json.dumps({
+            name: {"count": int(c), "total_ms": t * 1e3,
+                   "min_ms": (mn if mn != float("inf") else 0.0) * 1e3,
+                   "max_ms": mx * 1e3}
+            for name, (c, t, mn, mx) in rows})
+    else:
+        lines = [f"{'operator':<32}{'count':>8}{'total_ms':>12}"
+                 f"{'avg_ms':>10}{'min_ms':>10}{'max_ms':>10}"]
+        for name, (c, t, mn, mx) in rows:
+            mn = 0.0 if mn == float("inf") else mn
+            avg = t / c if c else 0.0
+            lines.append(f"{name:<32}{int(c):>8}{t * 1e3:>12.3f}"
+                         f"{avg * 1e3:>10.3f}{mn * 1e3:>10.3f}{mx * 1e3:>10.3f}")
+        out = "\n".join(lines)
+    if reset:
+        _agg.clear()
+    return out
+
+
+def trace_dir():
+    """Directory holding the last jax.profiler trace (None before a run)."""
+    return _trace_dir
+
+
+# ---------------------------------------------------------------------------
+# op-dispatch timing hook (host-side aggregate table)
+# ---------------------------------------------------------------------------
+def _install_op_timer():
+    if not (_config["aggregate_stats"] or _config["profile_imperative"]
+            or _config["profile_all"]):
+        return
+    from .ndarray import ndarray as _nd_mod
+
+    def timing_hook(impl, name, nd_inputs, attrs):
+        if _paused:
+            return impl(name, nd_inputs, attrs)
+        t0 = time.perf_counter()
+        out = impl(name, nd_inputs, attrs)
+        dt = time.perf_counter() - t0
+        ent = _agg[name]
+        ent[0] += 1
+        ent[1] += dt
+        ent[2] = min(ent[2], dt)
+        ent[3] = max(ent[3], dt)
+        return out
+
+    _nd_mod._PROFILE_HOOK = timing_hook
+
+
+def _uninstall_op_timer():
+    from .ndarray import ndarray as _nd_mod
+    _nd_mod._PROFILE_HOOK = None
+
+
+atexit.register(lambda: _state == "run" and set_state("stop"))
+
+
+# ---------------------------------------------------------------------------
+# object API (reference: profiler.py:151-400)
+# ---------------------------------------------------------------------------
+class Domain:
+    """Profiling domain — a namespace for tasks/counters
+    (reference: profiler.py:151)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_event(self, name):
+        return Event(name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+    def __str__(self):
+        return self.name
+
+
+class _Span:
+    """start()/stop() span recorded into the aggregate table and, when a
+    jax trace is running, as a TraceAnnotation on the device timeline."""
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+        self._t0 = None
+        self._ann = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+        try:
+            import jax
+            self._ann = jax.profiler.TraceAnnotation(
+                f"{self.domain}::{self.name}")
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+        return self
+
+    def stop(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        if self._t0 is not None:
+            dt = time.perf_counter() - self._t0
+            key = f"{self.domain}::{self.name}"
+            ent = _agg[key]
+            ent[0] += 1
+            ent[1] += dt
+            ent[2] = min(ent[2], dt)
+            ent[3] = max(ent[3], dt)
+            self._t0 = None
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Task(_Span):
+    """(reference: profiler.py:210)"""
+
+
+class Frame(_Span):
+    """(reference: profiler.py:252)"""
+
+
+class Event(_Span):
+    """(reference: profiler.py:294)"""
+
+    def __init__(self, name):
+        super().__init__("event", name)
+
+
+class Counter:
+    """Numeric counter (reference: profiler.py:330)."""
+
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        self.value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self.value = value
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+
+class Marker:
+    """Instant marker (reference: profiler.py:400)."""
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        ent = _agg[f"{self.domain}::{self.name}::marks"]
+        ent[0] += 1

@@ -1,0 +1,57 @@
+"""Monitor tests (reference: python/mxnet/monitor.py:33, executor monitor
+callback graph_executor.cc:121)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _bind_mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+    out = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    exe = out.simple_bind(mx.cpu(), data=(4, 10), grad_req="write")
+    for arr in exe.arg_arrays:
+        arr[:] = np.random.rand(*arr.shape).astype(np.float32)
+    return exe
+
+
+def test_monitor_observes_layer_outputs():
+    exe = _bind_mlp()
+    mon = mx.mon.Monitor(interval=1, pattern=".*fc1.*")
+    mon.install(exe, monitor_all=True)
+    mon.tic()
+    exe.forward(is_train=True)
+    res = mon.toc()
+    names = [k for (_, k, _) in res]
+    assert any("fc1" in n for n in names), names
+    # stats are formatted strings of scalars
+    assert all(isinstance(v, str) and v for (_, _, v) in res)
+
+
+def test_monitor_interval_gates_collection():
+    exe = _bind_mlp()
+    mon = mx.mon.Monitor(interval=2, pattern=".*")
+    mon.install(exe, monitor_all=True)
+    mon.tic()                       # step 0: active
+    exe.forward(is_train=True)
+    first = mon.toc()
+    assert first
+    mon.tic()                       # step 1: inactive (interval 2)
+    exe.forward(is_train=True)
+    assert mon.toc() == []
+
+
+def test_monitor_grad_stats():
+    exe = _bind_mlp()
+    mon = mx.mon.Monitor(interval=1, pattern=".*weight.*", sort=True)
+    mon.install(exe, monitor_all=True)
+    mon.tic()
+    exe.arg_dict["softmax_label"][:] = np.array([0, 1, 2, 0], np.float32)
+    exe.forward(is_train=True)
+    exe.backward()
+    res = mon.toc()
+    names = [k for (_, k, _) in res]
+    assert any(n.endswith("_grad") for n in names), names
