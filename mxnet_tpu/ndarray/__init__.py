@@ -159,23 +159,52 @@ def onehot_encode(indices, out):
     return out
 
 
-# -- serialization (reference: NDArray::Save src/ndarray/ndarray.cc:1571,
-#    python API mx.nd.save/load) — numpy .npz container with name keys.
-def save(fname, data):
+# -- serialization. Two formats by extension:
+#    *.params  -> the reference's dmlc-binary NDArray-map format, byte
+#                 compatible (reference: NDArray::Save src/ndarray/
+#                 ndarray.cc:1571,1769; see param_file.py)
+#    otherwise -> numpy .npz container with name keys (native format)
+def _split_save_arg(data):
     if isinstance(data, NDArray):
-        arrs, names = [data], ["0"]
-    elif isinstance(data, (list, tuple)):
-        arrs, names = list(data), [str(i) for i in range(len(data))]
-    elif isinstance(data, dict):
-        names, arrs = list(data.keys()), list(data.values())
-    else:
-        raise TypeError("save requires NDArray, list or dict")
+        return [data], None
+    if isinstance(data, (list, tuple)):
+        return list(data), None
+    if isinstance(data, dict):
+        return list(data.values()), list(data.keys())
+    raise TypeError("save requires NDArray, list or dict")
+
+
+def save(fname, data):
+    arrs, names = _split_save_arg(data)
+    if fname.endswith(".params"):
+        from .param_file import save_params
+        save_params(fname, arrs, names if names is not None else [])
+        return
+    names = names if names is not None else [str(i) for i in range(len(arrs))]
     with open(fname, "wb") as f:
         np.savez(f, __mxnet_tpu_names__=np.array(names, dtype=object),
                  **{f"arr_{i}": a.asnumpy() for i, a in enumerate(arrs)})
 
 
+def _is_dmlc_params(fname):
+    """Sniff the 8-byte list magic — .params files written by older builds
+    of this library are npz and must stay loadable."""
+    with open(fname, "rb") as f:
+        head = f.read(8)
+    return len(head) == 8 and \
+        int.from_bytes(head, "little") == 0x112
+
+
 def load(fname):
+    if fname.endswith(".params") and _is_dmlc_params(fname):
+        from .param_file import load_params
+        from .sparse import BaseSparseNDArray
+        raw, names = load_params(fname)
+        arrs = [a if isinstance(a, BaseSparseNDArray) else array(a)
+                for a in raw]
+        if names:
+            return dict(zip(names, arrs))
+        return arrs
     with np.load(fname, allow_pickle=True) as zf:
         names = [str(n) for n in zf["__mxnet_tpu_names__"]]
         arrs = [array(zf[f"arr_{i}"]) for i in range(len(names))]

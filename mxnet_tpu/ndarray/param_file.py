@@ -1,0 +1,183 @@
+"""Reference ``.params`` checkpoint interop: the dmlc-binary NDArray-map
+format, byte-compatible with the reference implementation.
+
+Format (reference: src/ndarray/ndarray.cc:1571-1790, little-endian):
+
+file container (NDArray::Save list form, ndarray.cc:1769):
+    uint64  0x112 (kMXAPINDArrayListMagic)
+    uint64  0 (reserved)
+    uint64  n_arrays, then per array: NDArray::Save
+    uint64  n_names,  then per name: uint64 length + bytes
+
+per array (NDArray::Save, ndarray.cc:1571 — V2):
+    uint32  0xF993fac9 (NDARRAY_V2_MAGIC)
+    int32   storage type (0 dense / 1 row_sparse / 2 csr, ndarray.h:61-65)
+    [sparse only] storage shape: uint32 ndim + int64[ndim] (values shape)
+    shape:  uint32 ndim + int64[ndim]
+    int32   dev_type (1 = kCPU), int32 dev_id    (Context::Save, base.h:188)
+    int32   type flag (mshadow: 0 f32, 1 f64, 2 f16, 3 u8, 4 i32, 5 i8, 6 i64)
+    [sparse only] per aux array: int32 aux type flag + aux shape
+    raw data bytes (values for sparse)
+    [sparse only] per aux array: raw bytes
+
+Aux order (ndarray.h): row_sparse = [indices]; csr = [indptr, indices].
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Sequence, Tuple, Union
+
+import numpy as np
+
+_LIST_MAGIC = 0x112
+_V2_MAGIC = 0xF993FAC9
+_V1_MAGIC = 0xF993FAC8
+
+_TYPE_FLAGS = {
+    np.dtype("float32"): 0, np.dtype("float64"): 1, np.dtype("float16"): 2,
+    np.dtype("uint8"): 3, np.dtype("int32"): 4, np.dtype("int8"): 5,
+    np.dtype("int64"): 6,
+}
+_FLAG_TYPES = {v: k for k, v in _TYPE_FLAGS.items()}
+_STYPES = {"default": 0, "row_sparse": 1, "csr": 2}
+
+
+def _w_shape(out: list, shape: Sequence[int]):
+    out.append(struct.pack("<I", len(shape)))
+    out.append(np.asarray(shape, "<i8").tobytes())
+
+
+def _r_shape(buf: memoryview, pos: int) -> Tuple[Tuple[int, ...], int]:
+    (ndim,) = struct.unpack_from("<I", buf, pos)
+    pos += 4
+    dims = np.frombuffer(buf, "<i8", ndim, pos)
+    return tuple(int(d) for d in dims), pos + 8 * ndim
+
+
+def _save_one(out: list, arr):
+    """Serialize one array (dense NDArray / numpy, or sparse NDArray)."""
+    stype = getattr(arr, "stype", "default")
+    out.append(struct.pack("<Ii", _V2_MAGIC, _STYPES[stype]))
+    if stype == "default":
+        data = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
+        if data.ndim == 0:
+            # the reference has no 0-d tensors: ndim==0 means "none" and
+            # ends the record (ndarray.cc "if (is_none()) return"), so a
+            # true scalar must be written as shape (1,) to survive
+            data = data.reshape(1)
+        _w_shape(out, data.shape)
+        out.append(struct.pack("<ii", 1, 0))  # kCPU, dev_id 0
+        out.append(struct.pack("<i", _TYPE_FLAGS[data.dtype]))
+        out.append(np.ascontiguousarray(data).tobytes())
+        return
+    values = np.asarray(arr._data)
+    if stype == "row_sparse":
+        auxes = [np.asarray(arr._indices, "<i8")]
+    else:
+        auxes = [np.asarray(arr._indptr, "<i8"),
+                 np.asarray(arr._indices, "<i8")]
+    _w_shape(out, values.shape)          # storage shape (values)
+    _w_shape(out, arr.shape)             # logical shape
+    out.append(struct.pack("<ii", 1, 0))
+    out.append(struct.pack("<i", _TYPE_FLAGS[values.dtype]))
+    for a in auxes:
+        out.append(struct.pack("<i", 6))  # aux type int64
+        _w_shape(out, a.shape)
+    out.append(np.ascontiguousarray(values).tobytes())
+    for a in auxes:
+        out.append(np.ascontiguousarray(a).tobytes())
+
+
+def _load_one(buf: memoryview, pos: int):
+    (magic,) = struct.unpack_from("<I", buf, pos)
+    pos += 4
+    if magic == _V2_MAGIC:
+        (stype,) = struct.unpack_from("<i", buf, pos)
+        pos += 4
+        storage_shape = None
+        if stype != 0:
+            storage_shape, pos = _r_shape(buf, pos)
+        shape, pos = _r_shape(buf, pos)
+    elif magic == _V1_MAGIC:
+        stype = 0
+        shape, pos = _r_shape(buf, pos)
+    else:
+        # legacy: the "magic" is the ndim of a uint32 shape
+        stype = 0
+        ndim = magic
+        dims = np.frombuffer(buf, "<u4", ndim, pos)
+        shape = tuple(int(d) for d in dims)
+        pos += 4 * ndim
+    if not shape:
+        # reference "none" NDArray: the record ends right after the shape
+        return np.zeros((), np.float32), pos
+    pos += 8  # Context: int32 dev_type + int32 dev_id (always load to host)
+    (type_flag,) = struct.unpack_from("<i", buf, pos)
+    pos += 4
+    dtype = _FLAG_TYPES[type_flag]
+    aux = []
+    if stype != 0:
+        n_aux = 1 if stype == 1 else 2
+        for _ in range(n_aux):
+            (aflag,) = struct.unpack_from("<i", buf, pos)
+            pos += 4
+            ashape, pos = _r_shape(buf, pos)
+            aux.append((_FLAG_TYPES[aflag], ashape))
+        n_vals = int(np.prod(storage_shape)) if storage_shape else 0
+        values = np.frombuffer(buf, dtype, n_vals, pos).reshape(storage_shape)
+        pos += n_vals * dtype.itemsize
+        aux_data = []
+        for adtype, ashape in aux:
+            n = int(np.prod(ashape)) if ashape else 0
+            aux_data.append(
+                np.frombuffer(buf, adtype, n, pos).reshape(ashape))
+            pos += n * adtype.itemsize
+        from .sparse import CSRNDArray, RowSparseNDArray
+        if stype == 1:
+            return RowSparseNDArray(values, aux_data[0], shape), pos
+        return CSRNDArray(values, aux_data[1], aux_data[0], shape), pos
+    n = int(np.prod(shape))
+    data = np.frombuffer(buf, dtype, n, pos).reshape(shape)
+    return data.copy(), pos + n * dtype.itemsize
+
+
+def save_params(fname: str, arrays: Sequence, names: Sequence[str]):
+    """Write a reference-format .params file
+    (reference: NDArray::Save ndarray.cc:1769, MXNDArraySave c_api.cc:272)."""
+    out: List[bytes] = [struct.pack("<QQ", _LIST_MAGIC, 0),
+                        struct.pack("<Q", len(arrays))]
+    for a in arrays:
+        _save_one(out, a)
+    out.append(struct.pack("<Q", len(names)))
+    for n in names:
+        b = n.encode("utf-8")
+        out.append(struct.pack("<Q", len(b)) + b)
+    with open(fname, "wb") as f:
+        f.write(b"".join(out))
+
+
+def load_params(fname: str) -> Tuple[list, List[str]]:
+    """Read a reference-format .params file; returns (arrays, names) where
+    names is [] for unnamed lists (reference: NDArray::Load ndarray.cc:1779)."""
+    with open(fname, "rb") as f:
+        buf = memoryview(f.read())
+    header, reserved = struct.unpack_from("<QQ", buf, 0)
+    if header != _LIST_MAGIC:
+        raise ValueError(f"{fname}: not an MXNet NDArray file "
+                         f"(bad magic {header:#x})")
+    pos = 16
+    (n_arr,) = struct.unpack_from("<Q", buf, pos)
+    pos += 8
+    arrays = []
+    for _ in range(n_arr):
+        arr, pos = _load_one(buf, pos)
+        arrays.append(arr)
+    (n_names,) = struct.unpack_from("<Q", buf, pos)
+    pos += 8
+    names = []
+    for _ in range(n_names):
+        (ln,) = struct.unpack_from("<Q", buf, pos)
+        pos += 8
+        names.append(bytes(buf[pos:pos + ln]).decode("utf-8"))
+        pos += ln
+    return arrays, names

@@ -245,8 +245,13 @@ class TrainStep:
                       for leaf in st) if st else ()
                 for ps, st, pv in zip(pshard, self._opt_state, self._pvals))
             in_shardings = (pshard, sshard, batch1, batch1, rep, rep)
+            # pin outputs to the same layout: without this GSPMD may pick a
+            # different sharding for the updated params, forcing a reshard
+            # of every parameter on every step's input boundary
+            out_shardings = (pshard, sshard, rep)
             self._step_jit = jax.jit(step_fn, donate_argnums=donate,
-                                     in_shardings=in_shardings)
+                                     in_shardings=in_shardings,
+                                     out_shardings=out_shardings)
         else:
             self._step_jit = jax.jit(step_fn, donate_argnums=donate)
 

@@ -85,3 +85,72 @@ def test_train_step_bf16_compute():
 def test_graft_entry_dryrun():
     import __graft_entry__
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_tp_param_spec_fn_matches_dp():
+    """Tensor-parallel parameter layouts via param_spec_fn with adam
+    (scalar step-counter leaf must replicate, param-shaped moment leaves
+    inherit the weight's sharding) — numerics must match plain DP
+    (reference analog: tests/python/unittest/test_model_parallel.py)."""
+    from jax.sharding import PartitionSpec as P
+
+    def make_mlp(prefix):
+        mx.random.seed(7)
+        net = nn.HybridSequential(prefix=prefix)
+        with net.name_scope():
+            net.add(nn.Dense(32, activation="relu"), nn.Dense(10))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    x = np.random.RandomState(0).randn(16, 12).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, (16,))
+
+    def spec_fn(p):
+        # shard Dense weights (units, in) over the model axis when the
+        # units dim divides the axis; replicate everything else
+        if p.name.endswith("weight") and len(p.shape) == 2 \
+                and p.shape[0] % 4 == 0:
+            return P("model", None)
+        return P()
+
+    losses = {}
+    for name, mesh, spec in [
+            ("dp", make_mesh({"data": 8}), None),
+            ("tp", make_mesh({"data": 2, "model": 4}), spec_fn)]:
+        step = TrainStep(make_mlp(f"tp_{name}_"), optimizer="adam",
+                         lr=0.01, mesh=mesh, param_spec_fn=spec)
+        losses[name] = [float(step(x, y).asscalar()) for _ in range(4)]
+    np.testing.assert_allclose(losses["dp"], losses["tp"], rtol=2e-4)
+
+
+def test_tp_weights_actually_sharded():
+    from jax.sharding import PartitionSpec as P
+
+    net = nn.HybridSequential(prefix="tpshard_")
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    mesh = make_mesh({"data": 2, "model": 4})
+
+    def spec_fn(p):
+        if p.name.endswith("weight") and len(p.shape) == 2 \
+                and p.shape[0] % 4 == 0:
+            return P("model", None)
+        return P()
+
+    step = TrainStep(net, optimizer="adam", lr=0.01, mesh=mesh,
+                     param_spec_fn=spec_fn)
+    x = np.zeros((8, 12), np.float32)
+    y = np.zeros((8,), np.int64)
+    step(x, y)
+    specs = {p.name: v.sharding.spec
+             for p, v in zip(step.param_list, step._pvals)}
+    w_specs = [s for n, s in specs.items() if n.endswith("weight")]
+    assert any(s == P("model", None) for s in w_specs), specs
+    # adam state: scalar t replicated, moment buffers shard like the weight
+    for st, v in zip(step._opt_state, step._pvals):
+        for leaf in st:
+            if getattr(leaf, "shape", None) == v.shape:
+                assert leaf.sharding.spec == v.sharding.spec
+            elif hasattr(leaf, "sharding"):
+                assert leaf.sharding.spec == P()
