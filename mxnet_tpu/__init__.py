@@ -19,6 +19,7 @@ __version__ = "0.1.0"
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import operator  # registers the Custom op before nd codegen runs
 from . import ndarray
 from . import ndarray as nd
 from . import autograd
@@ -50,6 +51,10 @@ from . import module as mod
 from . import monitor
 from . import monitor as mon
 from . import profiler
+from . import rtc
+from . import visualization
+from . import visualization as viz
+from . import contrib
 from . import gluon
 from . import rnn
 from . import parallel

@@ -96,6 +96,7 @@ class KVStore:
             agg = vals[0]
             for extra in vals[1:]:
                 agg = agg + extra
+            agg = self._apply_compression(k, agg)
             if self._updater is not None:
                 if k not in self._data:
                     raise ValueError(f"key {k} not initialized")
@@ -182,9 +183,27 @@ class KVStore:
         self._updater = opt.get_updater(optimizer)
 
     def set_gradient_compression(self, compression_params):
-        """(reference: kvstore.py set_gradient_compression;
-        native gradient_compression.h:37-134). Applied in the dist path."""
+        """Enable 2-bit gradient compression with error feedback on pushed
+        gradients (reference: kvstore.py set_gradient_compression; native
+        gradient_compression.h:37-134, applied at kvstore_dist.h:232 and
+        comm.h:489 ReduceCompressed)."""
+        from .gradient_compression import GradientCompression
+        if self.type not in ("device", "dist", "dist_sync", "dist_async",
+                             "dist_sync_device", "dist_device_sync"):
+            # the reference only supports compression for device/dist
+            # stores (kvstore.py set_gradient_compression check) — a
+            # 'local' store has no wire to save
+            raise ValueError("Gradient compression is not supported for "
+                             f"this type of kvstore: {self.type!r}")
         self._compression_params = dict(compression_params)
+        self._compression = GradientCompression(**self._compression_params)
+
+    def _apply_compression(self, k, agg):
+        comp = getattr(self, "_compression", None)
+        if comp is None or not comp.active or \
+                getattr(agg, "stype", "default") != "default":
+            return agg
+        return NDArray(comp.compress(k, agg._data))
 
     # -- cluster topology -----------------------------------------------------
     @property

@@ -59,15 +59,76 @@ def barrier():
         multihost_utils.sync_global_devices("mxnet_tpu_barrier")
 
 
+_reduce_cache = {}
+_mesh_cache = {}
+
+
+def _global_mesh():
+    import jax
+    from jax.sharding import Mesh
+    key = tuple(id(d) for d in jax.devices())
+    mesh = _mesh_cache.get(key)
+    if mesh is None:
+        devs = np.array(jax.devices()).reshape(jax.process_count(), -1)
+        mesh = Mesh(devs, ("proc", "local"))
+        _mesh_cache.clear()          # device topology changes invalidate all
+        _mesh_cache[key] = mesh
+    return mesh
+
+
+def _reduce_jit(mesh):
+    """One compiled cross-process sum over a (procs, n) buffer — the
+    collective rides DCN/ICI inside XLA, replacing a per-key host
+    round-trip. One jit wrapper per mesh; jit's own cache re-specializes
+    per input shape/dtype."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    key = id(mesh)
+    fn = _reduce_cache.get(key)
+    if fn is None:
+        _reduce_cache.clear()
+        fn = jax.jit(lambda x: x.sum(axis=0),
+                     out_shardings=NamedSharding(mesh, P()))
+        _reduce_cache[key] = fn
+    return fn
+
+
 def allreduce(array):
     """Sum an array across all processes (returns the global sum)."""
+    arrays = allreduce_batch([array])
+    return arrays[0]
+
+
+def allreduce_batch(arrays):
+    """Sum a *list* of arrays across all processes with ONE device
+    collective: everything is flattened into a single buffer, reduced as
+    one XLA computation, and split back (reference analog: the server
+    merging all keys of a push round, kvstore_dist_server.h:189 — but as a
+    batched allreduce instead of per-key RPCs)."""
     import jax
     import jax.numpy as jnp
     if jax.process_count() == 1:
-        return array
+        return list(arrays)
     from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(np.asarray(array))
-    return jnp.asarray(np.sum(gathered, axis=0))
+    from jax.sharding import PartitionSpec as P
+
+    arrays = [jnp.asarray(a) for a in arrays]
+    shapes = [a.shape for a in arrays]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    dtype = jnp.result_type(*arrays) if arrays else jnp.float32
+    flat = jnp.concatenate([a.astype(dtype).ravel() for a in arrays]) \
+        if arrays else jnp.zeros((0,), dtype)
+    mesh = _global_mesh()
+    global_buf = multihost_utils.host_local_array_to_global_array(
+        flat[None], mesh, P("proc"))
+    summed = _reduce_jit(mesh)(global_buf)
+    local = multihost_utils.global_array_to_host_local_array(
+        summed, mesh, P())
+    out, pos = [], 0
+    for a, shape, size in zip(arrays, shapes, sizes):
+        out.append(local[pos:pos + size].reshape(shape).astype(a.dtype))
+        pos += size
+    return out
 
 
 class DistKVStore(KVStore):
@@ -96,13 +157,26 @@ class DistKVStore(KVStore):
         keys, values = [key], [value]
         if isinstance(key, (list, tuple)):
             keys, values = list(key), list(value)
+        local = []
         for k, v in zip(keys, values):
             vals = v if isinstance(v, (list, tuple)) else [v]
             agg = vals[0]
             for extra in vals[1:]:
                 agg = agg + extra
-            # cross-process reduction (≙ server merge)
-            agg = _wrap(allreduce(agg._data))
+            # row sets differ per process: densify sparse grads for the
+            # uniform-shape collective (the reference instead re-encodes
+            # row keys per server, kvstore_dist.h EncodeRowSparseKey)
+            if getattr(agg, "stype", "default") != "default":
+                agg = agg.todense()
+            # worker-side 2-bit quantize with error feedback before the
+            # wire (reference: kvstore_dist.h:343-353)
+            agg = self._apply_compression(k, agg)
+            local.append((k, agg))
+        # one batched cross-process reduction for the whole push round
+        # (≙ server merge across NumWorkers() pushes)
+        reduced = allreduce_batch([a._data for _, a in local])
+        for (k, _), rdata in zip(local, reduced):
+            agg = _wrap(rdata)
             if self._updater is not None:
                 if k not in self._data:
                     raise ValueError(f"key {k} not initialized")

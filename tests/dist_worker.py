@@ -1,0 +1,98 @@
+"""Worker process for the multi-process distributed kvstore test.
+
+Launched N times locally by test_dist.py (the analog of
+``tools/launch.py -n N python dist_sync_kvstore.py`` — reference:
+tests/nightly/dist_sync_kvstore.py:29-80, test_all.sh:55). Each process is
+one jax.distributed participant with a single CPU device.
+
+Usage: dist_worker.py <coordinator> <num_procs> <rank> <ok_dir>
+"""
+import os
+import sys
+
+coordinator, n_procs, rank, ok_dir = sys.argv[1:5]
+n_procs, rank = int(n_procs), int(rank)
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ.pop("JAX_PLATFORMS", None)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=coordinator,
+                           num_processes=n_procs, process_id=rank)
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+kv = mx.kv.create("dist_sync")
+assert kv.rank == rank and kv.num_workers == n_procs
+
+# --- plain push/pull math (dist_sync_kvstore.py init_kv/test_sync_push_pull)
+shape = (3, 4)
+kv.init("w", nd.zeros(shape))
+kv.init("big", nd.zeros((8, 8)))
+
+for step in range(3):
+    # every rank pushes rank+1+step; merged value must be the global sum
+    kv.push(["w", "big"],
+            [nd.ones(shape) * (rank + 1 + step),
+             nd.ones((8, 8)) * (rank + 1 + step)])
+    expected = sum(r + 1 + step for r in range(n_procs))
+    out = nd.zeros(shape)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), expected, rtol=1e-6)
+    out2 = nd.zeros((8, 8))
+    kv.pull("big", out=out2)
+    np.testing.assert_allclose(out2.asnumpy(), expected, rtol=1e-6)
+
+# --- update_on_kvstore: server-side optimizer semantics
+kv2 = mx.kv.create("dist_sync")
+kv2.init("opt_w", nd.ones(shape))
+kv2.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, wd=0.0,
+                                   rescale_grad=1.0 / n_procs))
+kv2.push("opt_w", nd.ones(shape))          # every rank pushes grad=1
+out = nd.zeros(shape)
+kv2.pull("opt_w", out=out)
+# merged grad = n_procs, rescaled to 1 -> w = 1 - 0.1
+np.testing.assert_allclose(out.asnumpy(), 0.9, rtol=1e-5)
+
+# --- row_sparse gradient push (densified collective) + row_sparse_pull
+kv3 = mx.kv.create("dist_sync")
+kv3.init("emb", nd.zeros((6, 2)))
+row = rank % 6
+g = sparse.row_sparse_array(
+    (np.ones((1, 2), np.float32), np.array([row])), shape=(6, 2))
+kv3.push("emb", g)
+pulled = sparse.zeros("row_sparse", (6, 2))
+kv3.row_sparse_pull("emb", out=pulled,
+                    row_ids=nd.array(np.arange(6)))
+dense = pulled.asnumpy()
+expect = np.zeros((6, 2), np.float32)
+for r in range(n_procs):
+    expect[r % 6] += 1.0
+np.testing.assert_allclose(dense, expect, rtol=1e-6)
+
+# --- 2-bit compressed push across processes (reference:
+# tests/nightly/dist_sync_kvstore.py test_sync_2bit_compression)
+kv4 = mx.kv.create("dist_sync")
+kv4.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+kv4.init("cw", nd.zeros((4,)))
+kv4.push("cw", nd.ones((4,)) * 0.3)       # below threshold everywhere -> 0
+out = nd.zeros((4,))
+kv4.pull("cw", out=out)
+np.testing.assert_allclose(out.asnumpy(), 0.0)
+kv4.push("cw", nd.ones((4,)) * 0.3)       # residual kicks in -> each sends 0.5
+kv4.pull("cw", out=out)
+np.testing.assert_allclose(out.asnumpy(), 0.5 * n_procs, rtol=1e-6)
+
+from mxnet_tpu.parallel import dist
+dist.barrier()
+
+with open(os.path.join(ok_dir, f"ok_{rank}"), "w") as f:
+    f.write("ok")
+print(f"rank {rank}: all assertions passed")

@@ -1,0 +1,134 @@
+"""Custom op bridge + Pallas hook tests (reference:
+tests/python/unittest/test_operator.py test_custom_op,
+python/mxnet/operator.py:422-627; rtc capability: python/mxnet/rtc.py).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+@mx.operator.register("mysigmoid")
+class MySigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return MySigmoid()
+
+
+class MySigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        y = 1.0 / (1.0 + np.exp(-in_data[0].asnumpy()))
+        self.assign(out_data[0], req[0], nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        g = out_grad[0].asnumpy() * y * (1 - y)
+        self.assign(in_grad[0], req[0], nd.array(g))
+
+
+class TestCustomOp:
+    def test_forward(self):
+        x = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+        out = nd.Custom(nd.array(x), op_type="mysigmoid")
+        np.testing.assert_allclose(out.asnumpy(), 1 / (1 + np.exp(-x)),
+                                   rtol=1e-5)
+
+    def test_backward_through_tape(self):
+        x = np.random.RandomState(1).randn(3, 3).astype(np.float32)
+        xa = nd.array(x)
+        xa.attach_grad()
+        with mx.autograd.record():
+            y = nd.Custom(xa, op_type="mysigmoid")
+            loss = y.sum()
+        loss.backward()
+        s = 1 / (1 + np.exp(-x))
+        np.testing.assert_allclose(xa.grad.asnumpy(), s * (1 - s), rtol=1e-4)
+
+    def test_inside_jit(self):
+        # the staged path: Custom survives jax.jit via pure_callback
+        import jax
+        import jax.numpy as jnp
+        from mxnet_tpu.ops import get_op
+
+        fn = get_op("Custom").fn
+
+        @jax.jit
+        def jitted(a):
+            return fn(a, op_type="mysigmoid") * 2.0
+
+        x = np.random.RandomState(2).randn(4).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(jitted(jnp.asarray(x))),
+                                   2 / (1 + np.exp(-x)), rtol=1e-5)
+
+    def test_kwargs_parameterize_prop(self):
+        @mx.operator.register("scaler")
+        class ScalerProp(mx.operator.CustomOpProp):
+            def __init__(self, scale=1.0):
+                super().__init__(need_top_grad=True)
+                self.scale = float(scale)
+
+            def create_operator(self, ctx, in_shapes, in_dtypes):
+                prop = self
+
+                class Scaler(mx.operator.CustomOp):
+                    def forward(self, is_train, req, in_data, out_data, aux):
+                        self.assign(out_data[0], req[0],
+                                    in_data[0] * prop.scale)
+
+                    def backward(self, req, out_grad, in_data, out_data,
+                                 in_grad, aux):
+                        self.assign(in_grad[0], req[0],
+                                    out_grad[0] * prop.scale)
+                return Scaler()
+
+        out = nd.Custom(nd.ones((2,)), op_type="scaler", scale=3.0)
+        np.testing.assert_allclose(out.asnumpy(), [3.0, 3.0])
+
+    def test_unregistered_raises(self):
+        try:
+            nd.Custom(nd.ones((2,)), op_type="no_such_op")
+            assert False
+        except KeyError:
+            pass
+
+
+class TestPallasHook:
+    def test_register_pallas_op(self):
+        def double_kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0
+
+        pk = mx.operator.register_pallas(
+            "pallas_double", double_kernel, out_shape=lambda shapes: shapes[0],
+            vjp=lambda ct, x: (ct * 2.0,))
+        x = nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+        out = pk(x)
+        np.testing.assert_allclose(out.asnumpy(), x.asnumpy() * 2)
+        # registered as a first-class nd op
+        out2 = nd.pallas_double(x)
+        np.testing.assert_allclose(out2.asnumpy(), x.asnumpy() * 2)
+
+    def test_pallas_op_differentiable(self):
+        def scale_kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 3.0
+
+        pk = mx.operator.register_pallas(
+            "pallas_scale3", scale_kernel,
+            out_shape=lambda shapes: shapes[0],
+            vjp=lambda ct, x: (ct * 3.0,))
+        x = nd.array(np.ones((4,), np.float32))
+        x.attach_grad()
+        with mx.autograd.record():
+            loss = pk(x).sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.asnumpy(), 3.0)

@@ -1,0 +1,157 @@
+"""2-bit gradient compression tests.
+
+``compute_expected_2bit_quantization`` is a direct port of the reference's
+nightly oracle (reference: tests/nightly/test_kvstore.py:33-80) — the
+implementation must match it bit-exactly on the wire and numerically on
+residual/dequantized values.
+"""
+import struct
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gradient_compression import (GradientCompression,
+                                            dequantize_2bit, quantize_2bit)
+
+
+def compute_expected_2bit_quantization(arr, curr_residual, threshold):
+    """Port of the reference oracle (tests/nightly/test_kvstore.py:33)."""
+    def bits2int(bits):
+        bits = [int(x) for x in bits[::-1]]
+        x = 0
+        for i in range(len(bits)):
+            x += bits[i] * 2 ** i
+        return x
+
+    def as_float32(s):
+        return struct.unpack("f", struct.pack("I", bits2int(s)))[0]
+
+    str_quant = ""
+    new_residual = []
+    decompr = []
+    for i, a in np.ndenumerate(arr):
+        a += curr_residual[i]
+        if a >= threshold:
+            str_quant += "11"
+            new_residual.append(a - threshold)
+            decompr.append(threshold)
+        elif a <= (-1 * threshold):
+            str_quant += "10"
+            new_residual.append(a + threshold)
+            decompr.append(-1 * threshold)
+        else:
+            str_quant += "00"
+            new_residual.append(a)
+            decompr.append(0)
+    if len(str_quant) % 16 != 0:
+        str_quant += "0" * (16 - len(str_quant) % 16)
+    compr = []
+    i = 0
+    while i < len(str_quant):
+        cur_float = str_quant[i + 24:i + 32] + str_quant[i + 16:i + 24] \
+            + str_quant[i + 8:i + 16] + str_quant[i:i + 8]
+        compr.append(as_float32(cur_float))
+        i += 32
+    return np.array(compr, np.float32), \
+        np.array(new_residual, np.float32).reshape(arr.shape), \
+        np.array(decompr, np.float32).reshape(arr.shape)
+
+
+class TestQuantizeOracle:
+    def _check(self, arr, residual, threshold):
+        exp_compr, exp_res, exp_deq = compute_expected_2bit_quantization(
+            arr, residual, threshold)
+        packed, new_res, deq = quantize_2bit(arr, residual, threshold)
+        # bit-exact wire format
+        np.testing.assert_array_equal(
+            np.asarray(packed).view(np.uint32),
+            exp_compr.view(np.uint32))
+        np.testing.assert_allclose(np.asarray(new_res), exp_res,
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(deq), exp_deq)
+        # dequantize reverses the packing
+        back = dequantize_2bit(packed, arr.size, threshold, arr.shape)
+        np.testing.assert_allclose(np.asarray(back), exp_deq)
+
+    def test_simple(self):
+        arr = np.array([0.7, -0.6, 0.1, -0.2, 0.5, -0.5], np.float32)
+        self._check(arr, np.zeros_like(arr), 0.5)
+
+    def test_residual_feedback(self):
+        rng = np.random.RandomState(0)
+        arr = rng.randn(40).astype(np.float32)
+        residual = np.zeros_like(arr)
+        for _ in range(4):          # residual accumulates across rounds
+            exp_compr, exp_res, _ = compute_expected_2bit_quantization(
+                arr, residual, 0.5)
+            packed, new_res, _ = quantize_2bit(arr, residual, 0.5)
+            np.testing.assert_array_equal(
+                np.asarray(packed).view(np.uint32), exp_compr.view(np.uint32))
+            np.testing.assert_allclose(np.asarray(new_res), exp_res,
+                                       rtol=1e-5, atol=1e-6)
+            residual = exp_res
+
+    def test_non_multiple_of_16(self):
+        rng = np.random.RandomState(1)
+        for n in (1, 7, 16, 17, 33):
+            arr = (rng.randn(n) * 2).astype(np.float32)
+            self._check(arr, rng.randn(n).astype(np.float32) * 0.1, 0.5)
+
+    def test_random_2d(self):
+        rng = np.random.RandomState(2)
+        arr = rng.randn(8, 12).astype(np.float32)
+        self._check(arr, np.zeros_like(arr), 0.3)
+
+    def test_compressed_size(self):
+        gc = GradientCompression("2bit", 0.5)
+        assert gc.get_compressed_size(16) == 4
+        assert gc.get_compressed_size(17) == 8
+        assert GradientCompression("none").get_compressed_size(16) == 64
+
+
+class TestKVStoreCompression:
+    def test_push_applies_compression_with_residual(self):
+        # mirrors the nightly verify_residual flow
+        # (tests/nightly/test_kvstore.py:verify_residual)
+        kv = mx.kv.create("device")
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        shape = (4, 4)
+        kv.init("w", nd.zeros(shape))
+        # push 0.3: below threshold -> dequantized 0, residual 0.3
+        kv.push("w", nd.ones(shape) * 0.3)
+        out = nd.zeros(shape)
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 0.0)
+        # push 0.3 again: 0.3 + residual 0.3 >= 0.5 -> dequantized 0.5
+        kv.push("w", nd.ones(shape) * 0.3)
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 0.5)
+
+    def test_negative_and_updater(self):
+        kv = mx.kv.create("device")
+        kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+        shape = (3,)
+        kv.init("w", nd.zeros(shape))
+        kv.set_updater(lambda i, g, w: w.__isub__(g * 0.1))
+        kv.push("w", nd.ones(shape) * -2.5)     # -> dequantized -1.0 (+resid -1.5)
+        out = nd.zeros(shape)
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 0.1, rtol=1e-6)
+
+    def test_set_compression_validates(self):
+        kv = mx.kv.create("device")
+        try:
+            kv.set_gradient_compression({"type": "fp8"})
+            assert False, "expected ValueError"
+        except ValueError:
+            pass
+
+    def test_local_store_rejects_compression(self):
+        # reference: set_gradient_compression raises for 'local'
+        kv = mx.kv.create("local")
+        try:
+            kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+            assert False, "expected ValueError"
+        except ValueError:
+            pass
