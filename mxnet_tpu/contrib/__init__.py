@@ -1,0 +1,4 @@
+"""Contrib namespace (reference: python/mxnet/contrib/)."""
+from . import quantization  # noqa: F401
+from . import text  # noqa: F401
+from . import tensorboard  # noqa: F401

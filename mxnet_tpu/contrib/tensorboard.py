@@ -1,0 +1,49 @@
+"""TensorBoard logging bridge (reference:
+python/mxnet/contrib/tensorboard.py:25 LogMetricsCallback).
+
+The reference requires the ``tensorboard`` package's SummaryWriter. Here the
+callback prefers a TensorBoard writer when one is importable
+(tensorboardX / torch.utils.tensorboard) and otherwise falls back to a
+plain JSONL event log in ``logging_dir`` — same callback protocol, no hard
+dependency.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Epoch/batch-end callback logging eval metrics
+    (reference: tensorboard.py:25-75)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        os.makedirs(logging_dir, exist_ok=True)
+        self._writer = None
+        self._jsonl = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            self._writer = SummaryWriter(logging_dir)
+        except Exception:
+            self._jsonl = os.path.join(logging_dir, "metrics.jsonl")
+
+    def __call__(self, param):
+        """BatchEndParam protocol (reference: tensorboard.py:65)."""
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            if self._writer is not None:
+                self._writer.add_scalar(name, value, self.step)
+            else:
+                with open(self._jsonl, "a") as f:
+                    f.write(json.dumps({"step": self.step, "metric": name,
+                                        "value": float(value),
+                                        "ts": time.time()}) + "\n")

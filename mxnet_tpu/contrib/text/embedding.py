@@ -1,0 +1,183 @@
+"""Token embeddings loaded from pretrained files
+(reference: python/mxnet/contrib/text/embedding.py:132-720).
+
+Zero-egress environment: embeddings load from *local* files
+(``pretrained_file_path`` for CustomEmbedding, or ``embedding_root`` for
+GloVe/FastText file names already on disk) — the reference's download step
+(embedding.py:199 _get_pretrained_file) maps to pointing ``embedding_root``
+at a local repository.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .vocab import Vocabulary
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "GloVe", "FastText", "CustomEmbedding"]
+
+_embedding_registry: Dict[str, type] = {}
+
+
+def register(embedding_cls):
+    """(reference: embedding.py:39)"""
+    _embedding_registry[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """(reference: embedding.py:62)"""
+    name = embedding_name.lower()
+    if name not in _embedding_registry:
+        raise KeyError(f"unknown embedding {embedding_name!r}; registered: "
+                       f"{sorted(_embedding_registry)}")
+    return _embedding_registry[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """(reference: embedding.py:89)"""
+    if embedding_name is not None:
+        cls = _embedding_registry[embedding_name.lower()]
+        return list(cls.pretrained_file_names)
+    return {name: list(cls.pretrained_file_names)
+            for name, cls in _embedding_registry.items()}
+
+
+class _TokenEmbedding(Vocabulary):
+    """Base embedding: vocabulary + idx_to_vec matrix
+    (reference: embedding.py:132)."""
+
+    pretrained_file_names: tuple = ()
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    def _load_embedding(self, pretrained_file_path, elem_delim=" ",
+                        init_unknown_vec=np.zeros, encoding="utf-8"):
+        """Parse a GloVe/fastText-format text file
+        (reference: embedding.py:231-303)."""
+        if not os.path.isfile(pretrained_file_path):
+            raise FileNotFoundError(
+                f"{pretrained_file_path} not found. This environment has no "
+                "network egress: place the pretrained file locally and pass "
+                "its path (reference behavior downloads it).")
+        tokens, vectors = [], []
+        vec_len = None
+        with open(pretrained_file_path, encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if line_num == 0 and len(parts) == 2:
+                    continue  # fastText header: <count> <dim>
+                if len(parts) < 2:
+                    continue
+                token, elems = parts[0], parts[1:]
+                if vec_len is None:
+                    vec_len = len(elems)
+                elif len(elems) != vec_len:
+                    continue  # malformed line (reference warns and skips)
+                if token in self._token_to_idx and token not in tokens:
+                    pass  # keep later handling uniform
+                tokens.append(token)
+                vectors.append(np.asarray(elems, np.float32))
+        self._vec_len = vec_len or 0
+        all_tokens = [self.unknown_token] + tokens
+        self._idx_to_token = all_tokens
+        self._token_to_idx = {t: i for i, t in enumerate(all_tokens)}
+        mat = np.zeros((len(all_tokens), self._vec_len), np.float32)
+        mat[0] = init_unknown_vec(self._vec_len)
+        for i, v in enumerate(vectors):
+            mat[i + 1] = v
+        self._idx_to_vec = mat
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        """(n_tokens, vec_len) NDArray (reference: embedding.py:362)."""
+        from ...ndarray import array
+        return array(self._idx_to_vec)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """(reference: embedding.py:365)"""
+        from ...ndarray import array
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idxs = []
+        for t in toks:
+            if t in self._token_to_idx:
+                idxs.append(self._token_to_idx[t])
+            elif lower_case_backup and t.lower() in self._token_to_idx:
+                idxs.append(self._token_to_idx[t.lower()])
+            else:
+                idxs.append(0)
+        vecs = self._idx_to_vec[np.asarray(idxs)]
+        return array(vecs[0]) if single else array(vecs)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """(reference: embedding.py:404)"""
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        new = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else np.asarray(new_vectors)
+        new = new.reshape(len(toks), -1)
+        for t, v in zip(toks, new):
+            if t not in self._token_to_idx:
+                raise ValueError(f"token {t!r} is unknown; only tokens in "
+                                 "the vocabulary can be updated")
+            self._idx_to_vec[self._token_to_idx[t]] = v
+
+
+@register
+class GloVe(_TokenEmbedding):
+    """GloVe embeddings from a local file (reference: embedding.py:468)."""
+
+    pretrained_file_names = (
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt")
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=None, init_unknown_vec=np.zeros, **kwargs):
+        super().__init__(**kwargs)
+        root = embedding_root or os.path.join(
+            os.environ.get("MXNET_HOME", os.path.expanduser("~/.mxnet")),
+            "embeddings", "glove")
+        self._load_embedding(os.path.join(root, pretrained_file_name),
+                             " ", init_unknown_vec)
+
+
+@register
+class FastText(_TokenEmbedding):
+    """fastText embeddings from a local file (reference: embedding.py:558)."""
+
+    pretrained_file_names = (
+        "wiki.simple.vec", "wiki.en.vec", "wiki.zh.vec", "wiki.de.vec",
+        "wiki.fr.vec")
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=None, init_unknown_vec=np.zeros, **kwargs):
+        super().__init__(**kwargs)
+        root = embedding_root or os.path.join(
+            os.environ.get("MXNET_HOME", os.path.expanduser("~/.mxnet")),
+            "embeddings", "fasttext")
+        self._load_embedding(os.path.join(root, pretrained_file_name),
+                             " ", init_unknown_vec)
+
+
+@register
+class CustomEmbedding(_TokenEmbedding):
+    """Embedding from a user file: ``token<delim>v1<delim>v2...``
+    (reference: embedding.py:658)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf-8", init_unknown_vec=np.zeros, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)

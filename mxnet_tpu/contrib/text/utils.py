@@ -1,0 +1,21 @@
+"""Text helpers (reference: python/mxnet/contrib/text/utils.py)."""
+from __future__ import annotations
+
+import collections
+import re
+
+__all__ = ["count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Tokenize a string and count tokens (reference: utils.py:28)."""
+    source_str = re.sub(f"[{token_delim}{seq_delim}]+", " ",
+                        source_str).strip()
+    if to_lower:
+        source_str = source_str.lower()
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    if source_str:
+        counter.update(source_str.split(" "))
+    return counter

@@ -1,0 +1,82 @@
+"""Token indexing (reference: python/mxnet/contrib/text/vocab.py:30)."""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    """Indexing for text tokens (reference: vocab.py:30-170).
+
+    Index 0 is the unknown token; reserved tokens follow; then counter keys
+    by descending frequency (ties broken lexicographically), subject to
+    ``most_freq_count`` and ``min_freq``.
+    """
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        if reserved_tokens is not None:
+            rset = set(reserved_tokens)
+            if unknown_token in rset:
+                raise ValueError("reserved_tokens must not contain "
+                                 "unknown_token")
+            if len(rset) != len(reserved_tokens):
+                raise ValueError("reserved_tokens must not contain "
+                                 "duplicates")
+        self._unknown_token = unknown_token
+        self._idx_to_token = [unknown_token] + list(reserved_tokens or [])
+        self._reserved_tokens = list(reserved_tokens) \
+            if reserved_tokens else None
+        if counter is not None:
+            taken = set(self._idx_to_token)
+            pairs = sorted(counter.items(),
+                           key=lambda kv: (-kv[1], str(kv[0])))
+            budget = most_freq_count - len(self._idx_to_token) + 1 \
+                if most_freq_count is not None else None
+            added = 0
+            for tok, freq in pairs:
+                if freq < min_freq or tok in taken:
+                    continue
+                if budget is not None and added >= budget:
+                    break
+                self._idx_to_token.append(tok)
+                added += 1
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) -> index/indices (reference: vocab.py to_indices)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = [self._token_to_idx.get(t, 0) for t in toks]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        for i in idxs:
+            if not 0 <= i < len(self):
+                raise ValueError(f"token index {i} out of range")
+        out = [self._idx_to_token[i] for i in idxs]
+        return out[0] if single else out

@@ -175,6 +175,8 @@ def _split_save_arg(data):
 
 
 def save(fname, data):
+    import os
+    fname = os.fspath(fname)
     arrs, names = _split_save_arg(data)
     if fname.endswith(".params"):
         from .param_file import save_params
@@ -196,6 +198,8 @@ def _is_dmlc_params(fname):
 
 
 def load(fname):
+    import os
+    fname = os.fspath(fname)
     if fname.endswith(".params") and _is_dmlc_params(fname):
         from .param_file import load_params
         from .sparse import BaseSparseNDArray

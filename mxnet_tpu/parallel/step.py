@@ -266,8 +266,13 @@ class TrainStep:
                 import numpy as _np
                 from .. import autograd as _ag
                 xa = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+                xa1 = xa[:1]
+                if self.preprocess is not None:
+                    # the eager materialization forward must see the same
+                    # dtype/layout the compiled step computes on
+                    xa1 = self.preprocess(xa1)
                 with _ag.train_mode():
-                    self.net.forward(_wrap(xa[:1]))
+                    self.net.forward(_wrap(xa1))
                 self.param_list = self.net._get_param_list()
                 self._trainable = [p.grad_req != "null"
                                    for p in self.param_list]

@@ -1,0 +1,172 @@
+"""Network visualization (reference: python/mxnet/visualization.py —
+plot_network via graphviz, print_summary table).
+
+``plot_network`` emits Graphviz DOT. If the ``graphviz`` package is
+importable the reference-compatible ``graphviz.Digraph`` is returned;
+otherwise a ``DotGraph`` with the same ``.source``/``.render()`` surface is
+returned so the capability works without the dependency (zero-egress image).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+__all__ = ["plot_network", "print_summary"]
+
+_NODE_STYLE = {
+    "null": ("#8dd3c7", "oval"),
+    "FullyConnected": ("#fb8072", "box"),
+    "Convolution": ("#fb8072", "box"),
+    "Activation": ("#ffffb3", "box"),
+    "BatchNorm": ("#bebada", "box"),
+    "Pooling": ("#80b1d3", "box"),
+    "Concat": ("#fdb462", "box"),
+    "Flatten": ("#fdb462", "box"),
+    "SoftmaxOutput": ("#b3de69", "box"),
+}
+
+
+class DotGraph:
+    """Minimal stand-in for graphviz.Digraph (same source/render API)."""
+
+    def __init__(self, name="plot"):
+        self.name = name
+        self._lines = [f'digraph "{name}" {{',
+                       "node [fontsize=10];", "edge [fontsize=10];"]
+        self._closed = False
+
+    def node(self, name, label, **attrs):
+        a = "".join(f' {k}="{v}"' for k, v in attrs.items())
+        self._lines.append(f'"{name}" [label="{label}"{a}];')
+
+    def edge(self, src, dst, **attrs):
+        a = "".join(f' {k}="{v}"' for k, v in attrs.items())
+        self._lines.append(f'"{src}" -> "{dst}" [{a.strip()}];')
+
+    @property
+    def source(self):
+        return "\n".join(self._lines + ["}"])
+
+    def render(self, filename=None, format="dot", cleanup=False):
+        filename = filename or self.name
+        path = f"{filename}.{format}" if not filename.endswith(f".{format}") \
+            else filename
+        with open(path, "w") as f:
+            f.write(self.source)
+        return path
+
+    def _repr_svg_(self):  # pragma: no cover - notebook nicety
+        return None
+
+
+def _iter_nodes(symbol):
+    """Topological (creation-order) node list of a Symbol graph."""
+    seen = []
+    seen_ids = set()
+
+    def walk(node):
+        if id(node) in seen_ids:
+            return
+        for parent, _ in node.inputs:
+            walk(parent)
+        seen_ids.add(id(node))
+        seen.append(node)
+
+    syms = getattr(symbol, "_group", None) or [symbol]
+    for out in syms:
+        walk(out._node)
+    return seen
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a DOT graph of a Symbol (reference: visualization.py
+    plot_network)."""
+    import shutil
+    try:
+        from graphviz import Digraph
+        have_pkg = True
+    except ImportError:
+        have_pkg = False
+    if have_pkg and shutil.which("dot"):
+        dot = Digraph(name=title)
+    else:
+        # needs both the python pkg and the dot executable for render();
+        # otherwise use the self-contained DOT emitter
+        dot = DotGraph(name=title)
+    nodes = _iter_nodes(symbol)
+    arg_like = {".weight", "_weight", ".bias", "_bias", "_gamma", "_beta",
+                "_moving_mean", "_moving_var", "_running_mean",
+                "_running_var"}
+    hidden = set()
+    for n in nodes:
+        if n.op is None and hide_weights and \
+                any(n.name.endswith(s) for s in arg_like):
+            hidden.add(id(n))
+            continue
+        op = n.op or "null"
+        color, nshape = _NODE_STYLE.get(op, ("#d9d9d9", "box"))
+        label = n.name if n.op is None else f"{n.op}\\n{n.name}"
+        attrs = {"fillcolor": color, "shape": nshape, "style": "filled"}
+        attrs.update(node_attrs or {})
+        dot.node(n.name, label, **attrs)
+    for n in nodes:
+        if id(n) in hidden:
+            continue
+        for parent, _ in n.inputs:
+            if id(parent) in hidden:
+                continue
+            dot.edge(parent.name, n.name)
+    return dot
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Print a layer table: name, output shape where inferable, params
+    (reference: visualization.py print_summary)."""
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    cols = [int(line_length * p) for p in positions]
+    heads = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    shapes = {}
+    if shape is not None:
+        try:
+            arg_shapes, out_shapes, _ = symbol.infer_shape(**shape)
+            for name, s in zip(symbol.list_arguments(), arg_shapes):
+                shapes[name] = s
+        except Exception:
+            pass
+
+    def fmt_row(fields):
+        line = ""
+        for f, c in zip(fields, cols):
+            line = (line + str(f))[:c - 1].ljust(c)
+        return line
+
+    print("=" * line_length)
+    print(fmt_row(heads))
+    print("=" * line_length)
+    total_params = 0
+    import numpy as np
+    nodes = _iter_nodes(symbol)
+    node_params = {}
+    for n in nodes:
+        if n.op is None:
+            continue
+        layer_params = 0
+        prevs = []
+        for parent, _ in n.inputs:
+            if parent.op is None and parent.name != "data" and \
+                    not parent.name.endswith("label"):
+                s = shapes.get(parent.name)
+                if s:
+                    layer_params += int(np.prod(s))
+            else:
+                prevs.append(parent.name)
+        total_params += layer_params
+        out_shape = ""
+        print(fmt_row([f"{n.name} ({n.op})", out_shape, layer_params,
+                       ", ".join(prevs)]))
+        node_params[n.name] = layer_params
+    print("=" * line_length)
+    print(f"Total params: {total_params}")
+    print("=" * line_length)
+    return total_params

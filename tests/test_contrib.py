@@ -1,0 +1,193 @@
+"""Contrib tests: INT8 quantization, text embeddings/vocab, tensorboard
+bridge, visualization (reference: python/mxnet/contrib/,
+python/mxnet/visualization.py)."""
+import collections
+import json
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+
+
+class TestQuantization:
+    def _mlp(self):
+        mx.random.seed(0)
+        net = nn.HybridSequential(prefix="q_")
+        with net.name_scope():
+            net.add(nn.Dense(64, activation="relu"),
+                    nn.Dense(32, activation="relu"),
+                    nn.Dense(10))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    def test_quantize_net_close_to_fp32(self):
+        from mxnet_tpu.contrib.quantization import quantize_net
+        net = self._mlp()
+        rng = np.random.RandomState(0)
+        calib = [nd.array(rng.randn(16, 20).astype(np.float32))
+                 for _ in range(4)]
+        qnet = quantize_net(net, calib, calib_mode="naive")
+        x = nd.array(rng.randn(8, 20).astype(np.float32))
+        fp32 = net(x).asnumpy()
+        int8 = qnet(x).asnumpy()
+        # int8 sim must track fp32 closely relative to activation scale
+        denom = np.abs(fp32).max() + 1e-6
+        rel = np.abs(fp32 - int8).max() / denom
+        assert rel < 0.1, f"relative int8 error {rel}"
+        # argmax predictions agree on most samples
+        agree = (fp32.argmax(1) == int8.argmax(1)).mean()
+        assert agree >= 0.75, agree
+
+    def test_quantize_net_entropy_mode(self):
+        from mxnet_tpu.contrib.quantization import quantize_net
+        net = self._mlp()
+        rng = np.random.RandomState(1)
+        calib = [nd.array(rng.randn(16, 20).astype(np.float32))
+                 for _ in range(4)]
+        qnet = quantize_net(net, calib, calib_mode="entropy")
+        x = nd.array(rng.randn(4, 20).astype(np.float32))
+        fp32 = net(x).asnumpy()
+        int8 = qnet(x).asnumpy()
+        denom = np.abs(fp32).max() + 1e-6
+        assert np.abs(fp32 - int8).max() / denom < 0.25
+
+    def test_quantize_model_symbolic_facade(self):
+        from mxnet_tpu.contrib.quantization import quantize_model
+        sym = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                    name="fc")
+        rng = np.random.RandomState(0)
+        args = {"fc_weight": nd.array(rng.randn(4, 6).astype(np.float32)),
+                "fc_bias": nd.zeros((4,))}
+        qsym, qargs, qaux, th = quantize_model(sym, args, {})
+        assert "fc_weight_quantized" in qargs
+        assert qargs["fc_weight_quantized"].dtype == np.int8
+        # dequantized weight close to original
+        np.testing.assert_allclose(qargs["fc_weight"].asnumpy(),
+                                   args["fc_weight"].asnumpy(),
+                                   atol=float(th["fc_weight"]) / 127 + 1e-6)
+
+    def test_quantize_array(self):
+        from mxnet_tpu.contrib.quantization import quantize_array
+        a = np.array([-2.0, -1.0, 0.0, 1.0, 2.0], np.float32)
+        q, scale = quantize_array(nd.array(a))
+        np.testing.assert_allclose(np.asarray(q) * scale, a, atol=scale)
+        assert np.asarray(q).dtype == np.int8
+
+
+class TestTextContrib:
+    def test_vocabulary(self):
+        from mxnet_tpu.contrib.text import Vocabulary
+        counter = collections.Counter(
+            ["a", "a", "a", "b", "b", "c", "rare"])
+        v = Vocabulary(counter, min_freq=2, reserved_tokens=["<pad>"])
+        assert v.idx_to_token[0] == "<unk>"
+        assert v.idx_to_token[1] == "<pad>"
+        assert v.to_indices("a") == 2          # most frequent first
+        assert v.to_indices(["b", "zzz"]) == [3, 0]
+        assert v.to_tokens(2) == "a"
+        assert len(v) == 4                     # unk, pad, a, b
+
+    def test_count_tokens(self):
+        from mxnet_tpu.contrib.text.utils import count_tokens_from_str
+        c = count_tokens_from_str("a b  b\nc a", to_lower=False)
+        assert c["a"] == 2 and c["b"] == 2 and c["c"] == 1
+
+    def test_custom_embedding_from_file(self, tmp_path):
+        from mxnet_tpu.contrib.text.embedding import CustomEmbedding
+        p = tmp_path / "emb.txt"
+        p.write_text("hello 0.1 0.2 0.3\nworld 0.4 0.5 0.6\n")
+        emb = CustomEmbedding(str(p))
+        assert emb.vec_len == 3
+        np.testing.assert_allclose(
+            emb.get_vecs_by_tokens("world").asnumpy(), [0.4, 0.5, 0.6],
+            rtol=1e-6)
+        # unknown -> zeros
+        np.testing.assert_allclose(
+            emb.get_vecs_by_tokens("nope").asnumpy(), [0, 0, 0])
+        batch = emb.get_vecs_by_tokens(["hello", "world"])
+        assert batch.shape == (2, 3)
+        emb.update_token_vectors("hello", nd.array([1.0, 1.0, 1.0]))
+        np.testing.assert_allclose(
+            emb.get_vecs_by_tokens("hello").asnumpy(), [1, 1, 1])
+
+    def test_registry_create(self):
+        from mxnet_tpu.contrib.text import embedding as emb_mod
+        names = emb_mod.get_pretrained_file_names()
+        assert "glove" in names and "fasttext" in names
+
+
+class TestTensorboardBridge:
+    def test_log_metrics_callback(self, tmp_path):
+        from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+        cb = LogMetricsCallback(str(tmp_path), prefix="train")
+        metric = mx.metric.Accuracy()
+        metric.update([nd.array([0, 1])], [nd.array([0, 1])])
+
+        class Param:
+            eval_metric = metric
+        cb(Param())
+        files = os.listdir(tmp_path)
+        assert files, "no event files written"
+        jsonl = tmp_path / "metrics.jsonl"
+        if jsonl.exists():
+            rec = json.loads(jsonl.read_text().splitlines()[0])
+            assert rec["metric"].startswith("train-")
+
+
+class TestVisualization:
+    def _sym(self):
+        data = mx.sym.Variable("data")
+        fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+        fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+        return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    def test_plot_network_dot(self, tmp_path):
+        dot = mx.viz.plot_network(self._sym(), title="mlp")
+        src = dot.source
+        assert "fc1" in src and "relu1" in src and "->" in src
+        # weights hidden by default
+        assert "fc1_weight" not in src
+        path = dot.render(str(tmp_path / "mlp"), format="dot")
+        assert os.path.exists(path)
+
+    def test_plot_network_show_weights(self):
+        dot = mx.viz.plot_network(self._sym(), hide_weights=False)
+        assert "fc1_weight" in dot.source
+
+    def test_print_summary(self, capsys):
+        total = mx.viz.print_summary(self._sym(), shape={"data": (1, 16)})
+        out = capsys.readouterr().out
+        assert "fc1" in out and "Total params" in out
+        # fc1: 16*8+8, fc2: 8*3+3
+        assert total == 16 * 8 + 8 + 8 * 3 + 3
+
+
+class TestModelStore:
+    def test_get_model_file_missing_raises(self, tmp_path):
+        from mxnet_tpu.gluon.model_zoo.model_store import get_model_file
+        try:
+            get_model_file("resnet18_v1", root=str(tmp_path))
+            assert False
+        except FileNotFoundError as e:
+            assert "egress" in str(e)
+
+    def test_pretrained_loads_local_params(self, tmp_path, monkeypatch):
+        # drop a params file in the zoo root -> pretrained=True finds it
+        from mxnet_tpu.gluon.model_zoo.model_store import get_model_file
+        from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+        net = resnet18_v1()
+        net.initialize(mx.init.Xavier())
+        net(nd.zeros((1, 3, 32, 32)))  # materialize params
+        net.save_parameters(str(tmp_path / "resnet18_v1.params"))
+        path = get_model_file("resnet18_v1", root=str(tmp_path))
+        net2 = resnet18_v1(pretrained=True, root=str(tmp_path))
+        a = net.collect_params()
+        b = net2.collect_params()
+        k = sorted(a.keys())[0]
+        kb = sorted(b.keys())[0]
+        np.testing.assert_allclose(a[k].data().asnumpy(),
+                                   b[kb].data().asnumpy())
