@@ -12,6 +12,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .registry import register_op
 
@@ -427,3 +428,232 @@ def box_nms(data, overlap_thresh=0.5, topk=-1, coord_start=2, score_index=1,
         return jnp.where(keep[:, None], out, -1.0)
 
     return jax.vmap(one)(flat).reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# FFT / IFFT (reference: src/operator/contrib/fft-inl.h, ifft-inl.h —
+# cuFFT C2C; here jnp.fft, output layout interleaved [re, im] per element)
+# ---------------------------------------------------------------------------
+@register_op("fft", aliases=["_contrib_fft"])
+def fft(data, compute_size=128, **kw):
+    """Real input (..., d) -> (..., 2d) interleaved real/imag of the
+    unnormalized FFT along the last axis (reference: fft-inl.h; layout
+    verified against tests/python/gpu/test_operator_gpu.py:189)."""
+    spec = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([spec.real, spec.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype(data.dtype)
+
+
+@register_op("ifft", aliases=["_contrib_ifft"])
+def ifft(data, compute_size=128, **kw):
+    """Interleaved (..., 2d) -> real (..., d), unnormalized (x d) like
+    cuFFT inverse (reference: ifft-inl.h; test_operator_gpu.py:108
+    compares out/d with np.fft.ifft)."""
+    d = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (d, 2)).astype(jnp.float32)
+    spec = jax.lax.complex(pairs[..., 0], pairs[..., 1])
+    out = jnp.fft.ifft(spec, axis=-1).real * d
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Correlation (FlowNet cost volume; reference: src/operator/correlation.cc)
+# ---------------------------------------------------------------------------
+@register_op("Correlation")
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True, **kw):
+    """Patch correlation between two NCHW feature maps
+    (reference: correlation.cc:40-82 CorrelationForward). The reference's
+    6-deep displacement loop becomes one fused jnp expression per
+    displacement (G = (2*max_displacement/stride2+1)^2 static slices);
+    gradients come from autodiff instead of the hand-written backward.
+    """
+    kernel_size = int(kernel_size)
+    max_displacement = int(max_displacement)
+    stride1, stride2, pad_size = int(stride1), int(stride2), int(pad_size)
+    is_multiply = bool(is_multiply)
+    n, c, h, w = data1.shape
+    kernel_radius = (kernel_size - 1) // 2
+    border = max_displacement + kernel_radius
+    padded_h, padded_w = h + 2 * pad_size, w + 2 * pad_size
+    top_h = int(np.ceil((padded_h - border * 2) / float(stride1)))
+    top_w = int(np.ceil((padded_w - border * 2) / float(stride1)))
+    grid_radius = max_displacement // stride2
+    grid_width = 2 * grid_radius + 1
+    sumelems = kernel_size * kernel_size * c
+
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad_size, pad_size),
+                         (pad_size, pad_size)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad_size, pad_size),
+                         (pad_size, pad_size)))
+
+    # top-left corners of the kernel window in the padded maps:
+    # x1 = j*stride1 + max_displacement - kernel_radius ... but the
+    # reference indexes tmp[y1+h][x1+w] with y1 = i*stride1 + max_disp
+    # over a (kernel) window, i.e. window origin y1 (kernel_radius folded
+    # into border for the output size only)
+    ys = jnp.arange(top_h) * stride1 + max_displacement
+    xs = jnp.arange(top_w) * stride1 + max_displacement
+
+    outs = []
+    for tc in range(grid_width * grid_width):
+        s2o = (tc % grid_width - grid_radius) * stride2
+        s2p = (tc // grid_width - grid_radius) * stride2
+        acc = 0.0
+        for kh in range(kernel_size):
+            for kw_ in range(kernel_size):
+                a = p1[:, :, ys[:, None] + kh, xs[None, :] + kw_]
+                b = p2[:, :, ys[:, None] + s2p + kh,
+                       xs[None, :] + s2o + kw_]
+                acc = acc + (a * b if is_multiply else jnp.abs(a - b))
+        outs.append(acc.sum(axis=1) / sumelems)      # (n, top_h, top_w)
+    return jnp.stack(outs, axis=1)                   # (n, G^2, top_h, top_w)
+
+
+# ---------------------------------------------------------------------------
+# Crop (legacy; reference: src/operator/crop.cc MXNET_REGISTER_OP_PROPERTY)
+# ---------------------------------------------------------------------------
+@register_op("Crop", num_outputs=1)
+def crop_op(*inputs, offset=(0, 0), h_w=(0, 0), center_crop=False,
+            num_args=None, **kw):
+    """Crop an NCHW tensor to h_w or to the size of a second input
+    (reference: crop-inl.h)."""
+    data = inputs[0]
+    if len(inputs) > 1:
+        out_h, out_w = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        out_h, out_w = (int(x) for x in h_w)
+    if center_crop:
+        o_h = (data.shape[2] - out_h) // 2
+        o_w = (data.shape[3] - out_w) // 2
+    else:
+        o_h, o_w = (int(x) for x in offset)
+    return data[:, :, o_h:o_h + out_h, o_w:o_w + out_w]
+
+
+# ---------------------------------------------------------------------------
+# RPN Proposal (reference: src/operator/contrib/proposal.cc,
+# multi_proposal.cc)
+# ---------------------------------------------------------------------------
+def _generate_base_anchors(feature_stride, scales, ratios):
+    """(reference: proposal-inl.h:184-223 GenerateAnchors — including the
+    floor/round quirks, which the test-suite numerics depend on)."""
+    base = [0.0, 0.0, feature_stride - 1.0, feature_stride - 1.0]
+    w = base[2] - base[0] + 1.0
+    h = base[3] - base[1] + 1.0
+    x_ctr = base[0] + 0.5 * (w - 1.0)
+    y_ctr = base[1] + 0.5 * (h - 1.0)
+    size = w * h
+    anchors = []
+    for ratio in ratios:
+        size_ratio = np.floor(size / ratio)
+        new_w = np.floor(np.sqrt(size_ratio) + 0.5)
+        new_h = np.floor(new_w * ratio + 0.5)
+        for scale in scales:
+            sw, sh = new_w * scale, new_h * scale
+            anchors.append([x_ctr - 0.5 * (sw - 1), y_ctr - 0.5 * (sh - 1),
+                            x_ctr + 0.5 * (sw - 1), y_ctr + 0.5 * (sh - 1)])
+    return np.asarray(anchors, np.float32)
+
+
+def _proposal_one(scores_fg, bbox_deltas, im_info, base_anchors,
+                  feature_stride, rpn_pre_nms_top_n, rpn_post_nms_top_n,
+                  threshold, rpn_min_size):
+    """Single-image RPN proposal generation (reference: proposal.cc:300+
+    Forward): shift anchors, decode deltas, clip, filter small, pre-NMS
+    top-k, greedy NMS, post-NMS top-k."""
+    A = base_anchors.shape[0]
+    H, W = scores_fg.shape[1], scores_fg.shape[2]
+    shift_x = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    shift_y = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    # anchor layout index = h*(W*A) + w*A + a
+    sx = jnp.broadcast_to(shift_x[None, :, None], (H, W, A))
+    sy = jnp.broadcast_to(shift_y[:, None, None], (H, W, A))
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1)
+    anchors = (base_anchors[None, None, :, :] + shifts).reshape(-1, 4)
+    # deltas (4A, H, W) -> (H, W, A, 4) -> (N, 4); scores (A,H,W)->(N,)
+    d = bbox_deltas.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+    s = scores_fg.transpose(1, 2, 0).reshape(-1)
+
+    widths = anchors[:, 2] - anchors[:, 0] + 1.0
+    heights = anchors[:, 3] - anchors[:, 1] + 1.0
+    ctr_x = anchors[:, 0] + 0.5 * (widths - 1.0)
+    ctr_y = anchors[:, 1] + 0.5 * (heights - 1.0)
+    pred_ctr_x = d[:, 0] * widths + ctr_x
+    pred_ctr_y = d[:, 1] * heights + ctr_y
+    pred_w = jnp.exp(d[:, 2]) * widths
+    pred_h = jnp.exp(d[:, 3]) * heights
+    im_h, im_w = im_info[0], im_info[1]
+    x1 = jnp.clip(pred_ctr_x - 0.5 * (pred_w - 1), 0, im_w - 1)
+    y1 = jnp.clip(pred_ctr_y - 0.5 * (pred_h - 1), 0, im_h - 1)
+    x2 = jnp.clip(pred_ctr_x + 0.5 * (pred_w - 1), 0, im_w - 1)
+    y2 = jnp.clip(pred_ctr_y + 0.5 * (pred_h - 1), 0, im_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=1)
+    # filter too-small boxes (reference FilterBox: score -> -1)
+    iw = x2 - x1 + 1.0
+    ih = y2 - y1 + 1.0
+    min_size = rpn_min_size * im_info[2]  # scaled by im_scale
+    s = jnp.where((iw < min_size) | (ih < min_size), -1.0, s)
+
+    order = jnp.argsort(-s)
+    if rpn_pre_nms_top_n > 0:
+        order = order[:rpn_pre_nms_top_n]
+    boxes_s, s_s = boxes[order], s[order]
+    valid = s_s > -1.0
+    keep = _greedy_nms_keep(boxes_s, jnp.zeros(boxes_s.shape[0]), valid,
+                            threshold, True)
+    # compact kept boxes to the front, pad with the first kept one
+    rank = jnp.argsort(~keep, stable=True)       # kept first, stable order
+    boxes_k = boxes_s[rank]
+    score_k = s_s[rank]
+    n_keep = keep.sum()
+    idx = jnp.minimum(jnp.arange(rpn_post_nms_top_n), n_keep - 1)
+    rois = boxes_k[idx]
+    roi_scores = score_k[idx]
+    return rois, roi_scores
+
+
+@register_op("Proposal", aliases=["_contrib_Proposal"], no_grad=True,
+             num_outputs=1)
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+             output_score=False, iou_loss=False, **kw):
+    """RPN region proposals (reference: src/operator/contrib/proposal.cc).
+
+    cls_prob: (B, 2A, H, W) softmax fg/bg; bbox_pred: (B, 4A, H, W);
+    im_info: (B, 3) [height, width, scale]. Output rois
+    (B*rpn_post_nms_top_n, 5) rows [batch_idx, x1, y1, x2, y2].
+    """
+    if iou_loss:
+        raise NotImplementedError("Proposal: iou_loss=True")
+    scales = _tuplef(scales, (4, 8, 16, 32))
+    ratios = _tuplef(ratios, (0.5, 1, 2))
+    base = jnp.asarray(_generate_base_anchors(float(feature_stride),
+                                              scales, ratios))
+    B = cls_prob.shape[0]
+    A = base.shape[0]
+    rois_all, scores_all = [], []
+    for b in range(B):
+        fg = cls_prob[b, A:, :, :]  # foreground scores (A, H, W)
+        rois, rs = _proposal_one(
+            fg, bbox_pred[b], im_info[b], base, float(feature_stride),
+            int(rpn_pre_nms_top_n), int(rpn_post_nms_top_n),
+            float(threshold), float(rpn_min_size))
+        batch_col = jnp.full((rois.shape[0], 1), float(b))
+        rois_all.append(jnp.concatenate([batch_col, rois], axis=1))
+        scores_all.append(rs[:, None])
+    out = jnp.concatenate(rois_all, axis=0)
+    if output_score:
+        return out, jnp.concatenate(scores_all, axis=0)
+    return out
+
+
+@register_op("MultiProposal", aliases=["_contrib_MultiProposal"],
+             no_grad=True)
+def multi_proposal(cls_prob, bbox_pred, im_info, **kw):
+    """Batch variant (reference: src/operator/contrib/multi_proposal.cc —
+    same math as Proposal over every image)."""
+    kw.pop("output_score", None)
+    return proposal(cls_prob, bbox_pred, im_info, output_score=False, **kw)

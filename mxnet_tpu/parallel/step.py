@@ -189,7 +189,15 @@ class TrainStep:
 
         preprocess = self.preprocess
 
-        def step_fn(pvals, opt_state, x, y, key, lr):
+        # RNG: one base key captured at build; per-step keys are folded in
+        # from the update counter INSIDE the compiled step — an eager
+        # jax.random.split per step would cost a host->device dispatch
+        # round trip (expensive when the chip is reached over a network)
+        from .. import random as _random_mod
+        base_key = _random_mod.next_key()
+
+        def step_fn(pvals, opt_state, x, y, t, lr):
+            key = jax.random.fold_in(base_key, t)
             if preprocess is not None:
                 x = preprocess(x)
 
@@ -279,7 +287,6 @@ class TrainStep:
             self._init_state()
         if self._step_jit is None:
             self._build_step()
-        from .. import random as _random
         xa = x._data if isinstance(x, NDArray) else jnp.asarray(x)
         ya = y._data if isinstance(y, NDArray) else jnp.asarray(y)
         if self.mesh is not None:
@@ -289,7 +296,8 @@ class TrainStep:
         lr = self.lr if self.lr_schedule is None \
             else self.lr_schedule(self._num_update)
         self._pvals, self._opt_state, loss = self._step_jit(
-            self._pvals, self._opt_state, xa, ya, _random.next_key(),
+            self._pvals, self._opt_state, xa, ya,
+            jnp.asarray(self._num_update, jnp.uint32),
             jnp.asarray(lr, jnp.float32))
         self._num_update += 1
         return _wrap(loss)

@@ -115,3 +115,108 @@ def check_consistency(fn, inputs, ctx_list=None, rtol=1e-4, atol=1e-5):
 def list_gpus():
     from .context import num_gpus
     return list(range(num_gpus()))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-5,
+                           ctx=None, aux_states=None):
+    """Bind a symbol, run forward, compare every output against expected
+    numpy arrays (reference: test_utils.py:925)."""
+    ctx = ctx or default_context()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(sym.list_arguments(), location))
+    kwargs = {k: v.shape for k, v in location.items()}
+    exe = sym.simple_bind(ctx, **kwargs)
+    for name, arr in location.items():
+        exe.arg_dict[name][:] = np.asarray(arr)
+    if aux_states:
+        for name, arr in aux_states.items():
+            exe.aux_dict[name][:] = np.asarray(arr)
+    outputs = exe.forward(is_train=False)
+    if not isinstance(expected, (list, tuple)):
+        expected = [expected]
+    for out, exp in zip(outputs, expected):
+        assert_almost_equal(out, exp, rtol=rtol, atol=atol)
+    return [o.asnumpy() for o in outputs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-4,
+                            atol=1e-5, ctx=None, grad_req="write"):
+    """Bind with gradients, run forward+backward, compare arg gradients
+    (reference: test_utils.py:990)."""
+    ctx = ctx or default_context()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(sym.list_arguments(), location))
+    kwargs = {k: v.shape for k, v in location.items()}
+    exe = sym.simple_bind(ctx, grad_req=grad_req, **kwargs)
+    for name, arr in location.items():
+        exe.arg_dict[name][:] = np.asarray(arr)
+    exe.forward(is_train=True)
+    ogs = [nd.array(np.asarray(g)) for g in
+           (out_grads if isinstance(out_grads, (list, tuple)) else [out_grads])]
+    exe.backward(out_grads=ogs)
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(sym.list_arguments(), expected))
+    grads = dict(zip(sym.list_arguments(), exe.grad_arrays))
+    for name, exp in expected.items():
+        if exp is None:
+            continue
+        assert_almost_equal(grads[name], exp, rtol=rtol, atol=atol,
+                            names=(f"grad({name})", "expected"))
+    return grads
+
+
+def same_array(a, b):
+    """Whether two NDArrays share the same device buffer — the functional
+    analog of the reference's pointer check (test_utils.py same_array):
+    mutating one must be visible through the other."""
+    if a.shape != b.shape:
+        return False
+    old = a.asnumpy().copy()
+    a[:] = old + 1
+    shared = bool(np.allclose(b.asnumpy(), old + 1))
+    a[:] = old
+    return shared
+
+
+def rand_sparse_ndarray(shape, stype, density=0.2, dtype=None):
+    """Random sparse array + its dense numpy mirror
+    (reference: test_utils.py rand_sparse_ndarray)."""
+    arr = rand_ndarray(shape, stype=stype, density=density, dtype=dtype)
+    return arr, arr.asnumpy()
+
+
+def check_speed(sym=None, fn=None, location=None, ctx=None, N=20,
+                grad_req="null", typ="whole", **kwargs):
+    """Time forward (or forward+backward) executions/second
+    (reference: test_utils.py check_speed)."""
+    import time
+    ctx = ctx or default_context()
+    if fn is None:
+        shapes = {k: v.shape for k, v in (location or {}).items()}
+        exe = sym.simple_bind(ctx, grad_req=grad_req, **shapes)
+        for name, arr in (location or {}).items():
+            exe.arg_dict[name][:] = np.asarray(arr)
+
+        def fn():
+            out = exe.forward(is_train=grad_req != "null")
+            if grad_req != "null":
+                exe.backward()
+            out[0].wait_to_read()
+    fn()  # warmup / compile
+    t0 = time.perf_counter()
+    for _ in range(N):
+        fn()
+    dt = time.perf_counter() - t0
+    return dt / N
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Bind + forward in one call; returns numpy output(s)
+    (reference: test_utils.py simple_forward)."""
+    ctx = ctx or default_context()
+    shapes = {k: np.asarray(v).shape for k, v in inputs.items()}
+    exe = sym.simple_bind(ctx, **shapes)
+    for name, arr in inputs.items():
+        exe.arg_dict[name][:] = np.asarray(arr)
+    outputs = [o.asnumpy() for o in exe.forward(is_train=is_train)]
+    return outputs[0] if len(outputs) == 1 else outputs

@@ -1,0 +1,177 @@
+"""Tests for fft/ifft, Correlation, Crop, and RPN Proposal ops
+(reference: src/operator/contrib/fft-inl.h, src/operator/correlation.cc,
+src/operator/crop.cc, src/operator/contrib/proposal.cc; fft layout checks
+mirror tests/python/gpu/test_operator_gpu.py:108-240).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+class TestFFT:
+    def test_fft_matches_numpy_interleaved(self):
+        x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+        out = nd.fft(nd.array(x)).asnumpy()
+        ref = np.fft.fft(x)
+        expect = np.empty((3, 16), np.float32)
+        expect[:, 0::2] = ref.real
+        expect[:, 1::2] = ref.imag
+        np.testing.assert_allclose(out, expect, atol=1e-4)
+
+    def test_ifft_unnormalized(self):
+        # reference compares out/d with np.fft.ifft (test_operator_gpu:144)
+        x = np.random.RandomState(1).randn(2, 16).astype(np.float32)
+        out = nd.ifft(nd.array(x)).asnumpy()
+        cplx = x[:, 0::2] + 1j * x[:, 1::2]
+        ref = np.fft.ifft(cplx, axis=-1)
+        np.testing.assert_allclose(out / 8, ref.real, atol=1e-5)
+
+    def test_fft_ifft_roundtrip(self):
+        x = np.random.RandomState(2).randn(4, 10).astype(np.float32)
+        back = nd.ifft(nd.fft(nd.array(x))).asnumpy()
+        np.testing.assert_allclose(back, x * 10, rtol=1e-4, atol=1e-4)
+
+    def test_fft_4d(self):
+        x = np.random.RandomState(3).randn(2, 3, 4, 6).astype(np.float32)
+        out = nd.fft(nd.array(x)).asnumpy()
+        assert out.shape == (2, 3, 4, 12)
+        ref = np.fft.fft(x[0, 0, 0])
+        np.testing.assert_allclose(out[0, 0, 0, 0::2], ref.real, atol=1e-4)
+
+    def test_fft_grad(self):
+        x = nd.array(np.random.RandomState(4).randn(2, 4).astype(np.float32))
+        x.attach_grad()
+        with mx.autograd.record():
+            loss = (nd.fft(x) ** 2).sum()
+        loss.backward()
+        assert not np.allclose(x.grad.asnumpy(), 0)
+
+
+def _naive_correlation(d1, d2, max_disp, stride2=1, pad=0, multiply=True,
+                       kernel_size=1):
+    n, c, h, w = d1.shape
+    kr = (kernel_size - 1) // 2
+    border = max_disp + kr
+    ph, pw = h + 2 * pad, w + 2 * pad
+    th = int(np.ceil((ph - 2 * border) / 1.0))
+    tw = int(np.ceil((pw - 2 * border) / 1.0))
+    g = 2 * (max_disp // stride2) + 1
+    p1 = np.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = np.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    out = np.zeros((n, g * g, th, tw), np.float32)
+    sumelems = kernel_size * kernel_size * c
+    for tc in range(g * g):
+        s2o = (tc % g - max_disp // stride2) * stride2
+        s2p = (tc // g - max_disp // stride2) * stride2
+        for i in range(th):
+            for j in range(tw):
+                y1, x1 = i + max_disp, j + max_disp
+                for kh in range(kernel_size):
+                    for kw in range(kernel_size):
+                        a = p1[:, :, y1 + kh, x1 + kw]
+                        b = p2[:, :, y1 + s2p + kh, x1 + s2o + kw]
+                        out[:, tc, i, j] += \
+                            (a * b if multiply else np.abs(a - b)).sum(1)
+                out[:, tc, i, j] /= sumelems
+    return out
+
+
+class TestCorrelation:
+    def test_multiply_vs_naive(self):
+        rng = np.random.RandomState(0)
+        d1 = rng.randn(2, 3, 8, 8).astype(np.float32)
+        d2 = rng.randn(2, 3, 8, 8).astype(np.float32)
+        out = nd.Correlation(nd.array(d1), nd.array(d2), kernel_size=1,
+                             max_displacement=2, stride1=1, stride2=1,
+                             pad_size=2, is_multiply=True)
+        ref = _naive_correlation(d1, d2, max_disp=2, pad=2, multiply=True)
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_subtract_mode(self):
+        rng = np.random.RandomState(1)
+        d1 = rng.randn(1, 2, 6, 6).astype(np.float32)
+        d2 = rng.randn(1, 2, 6, 6).astype(np.float32)
+        out = nd.Correlation(nd.array(d1), nd.array(d2), kernel_size=1,
+                             max_displacement=1, pad_size=1,
+                             is_multiply=False)
+        ref = _naive_correlation(d1, d2, max_disp=1, pad=1, multiply=False)
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_identity_center_channel(self):
+        # zero displacement channel of corr(x, x) is mean of squares
+        rng = np.random.RandomState(2)
+        d = rng.randn(1, 4, 5, 5).astype(np.float32)
+        out = nd.Correlation(nd.array(d), nd.array(d), max_displacement=1,
+                             pad_size=1).asnumpy()
+        center = (2 * 1 + 1) ** 2 // 2
+        np.testing.assert_allclose(out[0, center], (d[0] ** 2).mean(0),
+                                   rtol=1e-4)
+
+
+class TestCrop:
+    def test_offset(self):
+        x = nd.array(np.arange(2 * 3 * 6 * 6, dtype=np.float32)
+                     .reshape(2, 3, 6, 6))
+        out = nd.Crop(x, offset=(1, 2), h_w=(3, 3))
+        np.testing.assert_array_equal(out.asnumpy(),
+                                      x.asnumpy()[:, :, 1:4, 2:5])
+
+    def test_center_crop(self):
+        x = nd.array(np.arange(1 * 1 * 6 * 6, dtype=np.float32)
+                     .reshape(1, 1, 6, 6))
+        out = nd.Crop(x, h_w=(4, 4), center_crop=True)
+        np.testing.assert_array_equal(out.asnumpy(),
+                                      x.asnumpy()[:, :, 1:5, 1:5])
+
+    def test_crop_like(self):
+        x = nd.zeros((1, 2, 8, 8))
+        like = nd.zeros((1, 2, 5, 5))
+        out = nd.Crop(x, like, offset=(0, 0))
+        assert out.shape == (1, 2, 5, 5)
+
+
+class TestProposal:
+    def _run(self, post_n=8, **kwargs):
+        rng = np.random.RandomState(0)
+        A, H, W = 3, 4, 4
+        cls_prob = rng.rand(1, 2 * A, H, W).astype(np.float32)
+        bbox_pred = (rng.randn(1, 4 * A, H, W) * 0.1).astype(np.float32)
+        im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+        return nd.Proposal(nd.array(cls_prob), nd.array(bbox_pred),
+                           nd.array(im_info), rpn_pre_nms_top_n=12,
+                           rpn_post_nms_top_n=post_n, threshold=0.7,
+                           rpn_min_size=4, scales=(2.0,),
+                           ratios=(0.5, 1.0, 2.0), feature_stride=16,
+                           **kwargs)
+
+    def test_shape_and_clipping(self):
+        rois = self._run().asnumpy()
+        assert rois.shape == (8, 5)
+        assert (rois[:, 0] == 0).all()               # batch index column
+        assert (rois[:, 1] >= 0).all() and (rois[:, 3] <= 63).all()
+        assert (rois[:, 2] >= 0).all() and (rois[:, 4] <= 63).all()
+        # valid boxes: x2 >= x1, y2 >= y1
+        assert (rois[:, 3] >= rois[:, 1]).all()
+        assert (rois[:, 4] >= rois[:, 2]).all()
+
+    def test_output_score(self):
+        rois, scores = self._run(output_score=True)
+        assert rois.shape[0] == scores.shape[0]
+        s = scores.asnumpy().reshape(-1)
+        assert (np.diff(s) <= 1e-6).all()            # sorted descending
+
+    def test_batch_indices(self):
+        rng = np.random.RandomState(1)
+        A, H, W = 2, 3, 3
+        cls_prob = rng.rand(2, 2 * A, H, W).astype(np.float32)
+        bbox_pred = (rng.randn(2, 4 * A, H, W) * 0.1).astype(np.float32)
+        im_info = np.array([[48, 48, 1.0], [48, 48, 1.0]], np.float32)
+        rois = nd.MultiProposal(nd.array(cls_prob), nd.array(bbox_pred),
+                                nd.array(im_info), rpn_pre_nms_top_n=10,
+                                rpn_post_nms_top_n=4, scales=(2.0,),
+                                ratios=(0.5, 1.0),
+                                feature_stride=16).asnumpy()
+        assert rois.shape == (8, 5)
+        assert (rois[:4, 0] == 0).all() and (rois[4:, 0] == 1).all()
