@@ -52,6 +52,7 @@ from . import monitor
 from . import monitor as mon
 from . import profiler
 from . import rtc
+from . import config
 from . import visualization
 from . import visualization as viz
 from . import contrib

@@ -1,0 +1,94 @@
+"""Runtime configuration via environment variables.
+
+TPU-native rebuild of the reference's env-var layer (reference:
+dmlc::GetEnv call sites; canonical list docs/faq/env_var.md). Variables
+keep the MXNET_ prefix so reference users' muscle memory carries over;
+each is registered with a type, default, and description, and
+``mxnet_tpu.config.show()`` prints the table (the reference documents them
+only in docs).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict
+
+__all__ = ["get", "register", "show", "variables"]
+
+
+def _bool(v: str) -> bool:
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+_REGISTRY: Dict[str, tuple] = {}
+
+
+def register(name: str, default, typ: Callable = str, doc: str = ""):
+    """Register a configuration variable."""
+    _REGISTRY[name] = (default, typ, doc)
+    return name
+
+
+def get(name: str, default=None):
+    """Read a registered variable from the environment (typed), or the
+    registered default (reference: dmlc::GetEnv)."""
+    if name in _REGISTRY:
+        reg_default, typ, _ = _REGISTRY[name]
+        raw = os.environ.get(name)
+        if raw is None:
+            return default if default is not None else reg_default
+        return typ(raw) if typ is not bool else _bool(raw)
+    raw = os.environ.get(name)
+    return raw if raw is not None else default
+
+
+def variables():
+    """{name: (default, current, doc)} for every registered variable."""
+    return {name: (d, get(name), doc)
+            for name, (d, _t, doc) in sorted(_REGISTRY.items())}
+
+
+def show():
+    """Print the configuration table (reference: docs/faq/env_var.md)."""
+    lines = [f"{'variable':<36}{'default':<18}{'current':<18}description"]
+    for name, (default, current, doc) in variables().items():
+        lines.append(f"{name:<36}{str(default):<18}{str(current):<18}{doc}")
+    out = "\n".join(lines)
+    print(out)
+    return out
+
+
+# -- the registered surface (reference: docs/faq/env_var.md) -----------------
+register("MXNET_HOME", os.path.expanduser("~/.mxnet"), str,
+         "Root for downloaded/converted data and embeddings "
+         "(env_var.md:125 MXNET_GLUON_REPO analog)")
+register("MXNET_TPU_MODEL_ZOO", os.path.expanduser("~/.mxnet_tpu/models"),
+         str, "Local directory holding pretrained .params files")
+register("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000, int,
+         "Batched-allreduce chunking threshold in elements "
+         "(env_var.md:74; kvstore_dist.h:58)")
+register("MXNET_PROFILER_AUTOSTART", False, bool,
+         "Start the profiler at import (env_var.md:105)")
+register("MXNET_PROFILER_MODE", "symbolic", str,
+         "Profiler mode hint (env_var.md:108)")
+register("MXNET_CPU_WORKER_NTHREADS", 1, int,
+         "DataLoader worker processes default (env_var.md:13)")
+register("MXNET_ENGINE_TYPE", "XLA", str,
+         "Engine identifier — informational; XLA async dispatch replaces "
+         "ThreadedEngine/NaiveEngine (env_var.md:52)")
+register("MXNET_EXEC_BULK_EXEC_TRAIN", True, bool,
+         "Whole-step fusion — informational; jit fuses the full step "
+         "(env_var.md:62)")
+register("MXNET_BACKWARD_DO_MIRROR", False, bool,
+         "Recompute activations in backward (jax.checkpoint) to trade "
+         "FLOPs for memory (env_var.md:93)")
+
+
+def _autostart_profiler():
+    if get("MXNET_PROFILER_AUTOSTART"):
+        from . import profiler
+        profiler.set_config(filename=os.path.join(
+            os.getcwd(), "profile.json"), aggregate_stats=True)
+        profiler.set_state("run")
+
+
+_autostart_profiler()

@@ -1,0 +1,2 @@
+"""ONNX model import (reference: python/mxnet/contrib/onnx/_import/)."""
+from .import_model import import_model, import_onnx_graph  # noqa: F401

@@ -1,0 +1,223 @@
+"""ONNX graph -> Symbol converter.
+
+TPU-native rebuild of the reference importer (reference:
+python/mxnet/contrib/onnx/_import/import_model.py, import_onnx.py,
+import_helper.py op mapping). The converter walks the ONNX graph in
+topological order, mapping each node onto the registered op surface;
+initializer tensors become arg_params.
+
+The ``onnx`` package is only needed to *parse* .onnx files
+(``import_model``); ``import_onnx_graph`` accepts any object with the
+GraphProto structure (node/input/output/initializer), so converted graphs
+and the op mapping are testable without the dependency.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = ["import_model", "import_onnx_graph"]
+
+
+def _attr_value(a):
+    """Decode an AttributeProto-shaped object to a python value."""
+    if hasattr(a, "type"):
+        # real onnx AttributeProto: type enum selects the field
+        t = a.type
+        mapping = {1: "f", 2: "i", 3: "s", 4: "t", 6: "floats", 7: "ints"}
+        field = mapping.get(t)
+        if field:
+            v = getattr(a, field)
+            if field == "s":
+                return v.decode() if isinstance(v, bytes) else v
+            if field in ("floats", "ints"):
+                return tuple(v)
+            return v
+    for field in ("ints", "floats"):
+        v = getattr(a, field, None)
+        if v:
+            return tuple(v)
+    for field in ("i", "f", "s"):
+        if getattr(a, field, None) is not None:
+            v = getattr(a, field)
+            return v.decode() if isinstance(v, bytes) else v
+    raise ValueError(f"cannot decode ONNX attribute {a!r}")
+
+
+def _attrs(node) -> Dict:
+    return {a.name: _attr_value(a) for a in getattr(node, "attribute", ())}
+
+
+def _tensor_to_np(t):
+    """TensorProto-shaped -> numpy."""
+    if hasattr(t, "raw_data") and getattr(t, "raw_data", b""):
+        try:
+            from onnx import numpy_helper
+            return numpy_helper.to_array(t)
+        except ImportError:
+            dt = {1: np.float32, 6: np.int32, 7: np.int64,
+                  11: np.float64}.get(getattr(t, "data_type", 1), np.float32)
+            return np.frombuffer(t.raw_data, dt).reshape(tuple(t.dims))
+    for field, dt in (("float_data", np.float32), ("int64_data", np.int64),
+                      ("int32_data", np.int32), ("double_data", np.float64)):
+        data = list(getattr(t, field, ()) or ())
+        if data:
+            return np.asarray(data, dt).reshape(tuple(t.dims))
+    if hasattr(t, "array"):
+        return np.asarray(t.array)
+    raise ValueError(f"cannot decode ONNX tensor {getattr(t, 'name', t)!r}")
+
+
+def _pool_attrs(attrs, pool_type):
+    kernel = tuple(attrs.get("kernel_shape", (1, 1)))
+    stride = tuple(attrs.get("strides", (1,) * len(kernel)))
+    pads = tuple(attrs.get("pads", (0,) * 2 * len(kernel)))
+    return dict(kernel=kernel, stride=stride, pad=pads[:len(kernel)],
+                pool_type=pool_type)
+
+
+def import_onnx_graph(graph):
+    """Convert a GraphProto-shaped object; returns
+    (sym, arg_params, aux_params) — the reference's from_onnx contract
+    (reference: import_onnx.py GraphProto.from_onnx)."""
+    from ... import symbol as sym_mod
+    from ...ndarray import array as nd_array
+    from ...symbol.symbol import var as sym_var
+
+    params = {t.name: _tensor_to_np(t) for t in graph.initializer}
+    tensors: Dict[str, object] = {}
+    aux_names: List[str] = []
+
+    for inp in graph.input:
+        name = inp if isinstance(inp, str) else inp.name
+        if name not in params:
+            tensors[name] = sym_var(name)
+
+    def get(name):
+        if name in tensors:
+            return tensors[name]
+        if name in params:
+            tensors[name] = sym_var(name)
+            return tensors[name]
+        raise KeyError(f"ONNX tensor {name!r} referenced before definition")
+
+    for node in graph.node:
+        op = node.op_type
+        attrs = _attrs(node)
+        ins = [get(n) for n in node.input if n]
+        name = node.name or node.output[0]
+        if op == "Conv":
+            kernel = tuple(attrs.get("kernel_shape"))
+            out = sym_mod.Convolution(
+                *ins, kernel=kernel,
+                stride=tuple(attrs.get("strides", (1,) * len(kernel))),
+                pad=tuple(attrs.get("pads", (0,) * 2 * len(kernel)))[:len(kernel)],
+                dilate=tuple(attrs.get("dilations", (1,) * len(kernel))),
+                num_filter=params[node.input[1]].shape[0],
+                num_group=int(attrs.get("group", 1)),
+                no_bias=len(ins) < 3, name=name)
+        elif op == "Gemm":
+            w = params[node.input[1]]
+            if not attrs.get("transB", 0):
+                # our FullyConnected wants (units, in); transpose stored W
+                params[node.input[1]] = np.ascontiguousarray(w.T)
+            out = sym_mod.FullyConnected(
+                *ins, num_hidden=params[node.input[1]].shape[0],
+                no_bias=len(ins) < 3, name=name)
+        elif op == "MatMul":
+            out = sym_mod.dot(*ins, name=name)
+        elif op in ("Relu", "Sigmoid", "Tanh"):
+            out = sym_mod.Activation(ins[0], act_type=op.lower(), name=name)
+        elif op == "Softmax":
+            out = sym_mod.softmax(ins[0], axis=int(attrs.get("axis", -1)),
+                                  name=name)
+        elif op == "MaxPool":
+            out = sym_mod.Pooling(ins[0], **_pool_attrs(attrs, "max"),
+                                  name=name)
+        elif op == "AveragePool":
+            out = sym_mod.Pooling(ins[0], **_pool_attrs(attrs, "avg"),
+                                  name=name)
+        elif op == "GlobalAveragePool":
+            out = sym_mod.Pooling(ins[0], kernel=(1, 1), pool_type="avg",
+                                  global_pool=True, name=name)
+        elif op == "BatchNormalization":
+            out = sym_mod.BatchNorm(
+                *ins, eps=float(attrs.get("epsilon", 1e-5)),
+                momentum=float(attrs.get("momentum", 0.9)),
+                fix_gamma=False, name=name)
+            aux_names.extend(node.input[3:5])
+        elif op == "Add":
+            out = sym_mod.broadcast_add(*ins, name=name)
+        elif op == "Sub":
+            out = sym_mod.broadcast_sub(*ins, name=name)
+        elif op == "Mul":
+            out = sym_mod.broadcast_mul(*ins, name=name)
+        elif op == "Div":
+            out = sym_mod.broadcast_div(*ins, name=name)
+        elif op == "Sum":
+            out = ins[0]
+            for extra in ins[1:]:
+                out = sym_mod.broadcast_add(out, extra)
+        elif op == "Flatten":
+            out = sym_mod.Flatten(ins[0], name=name)
+        elif op == "Reshape":
+            if len(node.input) > 1 and node.input[1] in params:
+                shape = tuple(int(s) for s in params.pop(node.input[1]))
+            else:
+                shape = tuple(attrs.get("shape", ()))
+            out = sym_mod.Reshape(ins[0], shape=shape, name=name)
+        elif op == "Transpose":
+            out = sym_mod.transpose(ins[0],
+                                    axes=tuple(attrs.get("perm", ())),
+                                    name=name)
+        elif op == "Concat":
+            out = sym_mod.concat(*ins, dim=int(attrs.get("axis", 1)),
+                                 name=name)
+        elif op == "Dropout":
+            out = sym_mod.Dropout(ins[0], p=float(attrs.get("ratio", 0.5)),
+                                  name=name)
+        elif op == "Identity":
+            out = ins[0]
+        elif op == "Constant":
+            params[node.output[0]] = _tensor_to_np(attrs["value"])
+            tensors[node.output[0]] = sym_var(node.output[0])
+            continue
+        elif op == "Pad":
+            pads = tuple(attrs.get("pads", ()))
+            out = sym_mod.Pad(ins[0], mode=attrs.get("mode", "constant"),
+                              pad_width=pads, name=name)
+        elif op == "Clip":
+            out = sym_mod.clip(ins[0],
+                               a_min=float(attrs.get("min", -np.inf)),
+                               a_max=float(attrs.get("max", np.inf)),
+                               name=name)
+        else:
+            raise NotImplementedError(
+                f"ONNX op {op!r} is not mapped (reference coverage: "
+                "contrib/onnx/_import/import_helper.py)")
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for out_name, o in zip(node.output, outs):
+            tensors[out_name] = o
+
+    out_syms = [tensors[o if isinstance(o, str) else o.name]
+                for o in graph.output]
+    sym = out_syms[0] if len(out_syms) == 1 else sym_mod.Group(out_syms)
+    arg_params = {k: nd_array(v) for k, v in params.items()
+                  if k not in aux_names}
+    aux_params = {k: nd_array(params[k]) for k in aux_names if k in params}
+    return sym, arg_params, aux_params
+
+
+def import_model(model_file):
+    """Load an .onnx file (reference: import_model.py:import_model).
+    Requires the ``onnx`` package for protobuf parsing."""
+    try:
+        import onnx
+    except ImportError as e:
+        raise ImportError(
+            "import_model requires the 'onnx' package to parse .onnx "
+            "protobufs; import_onnx_graph accepts an already-parsed "
+            "GraphProto") from e
+    model = onnx.load(model_file)
+    return import_onnx_graph(model.graph)

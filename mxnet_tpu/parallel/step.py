@@ -100,6 +100,15 @@ _OPTS = {
 }
 
 
+def _remat_staged(staged):
+    """Wrap the staged forward in jax.checkpoint. The inner function
+    records ``_write_params`` on itself AT TRACE TIME (block.py:484), so
+    the wrapper keeps a reference for the BatchNorm fold to read."""
+    wrapped = jax.checkpoint(staged)
+    wrapped._inner = staged
+    return wrapped
+
+
 class TrainStep:
     """One-XLA-computation training step for a HybridBlock.
 
@@ -118,11 +127,16 @@ class TrainStep:
                  optimizer_params=None, mesh: Optional[Mesh] = None,
                  data_axis="data", compute_dtype=None, lr=0.01,
                  lr_schedule: Optional[Callable[[int], float]] = None,
-                 param_spec_fn=None, preprocess=None):
+                 param_spec_fn=None, preprocess=None, remat=None):
         """``preprocess``: optional on-device fn applied to the data batch
         inside the compiled step (e.g. uint8 decode -> normalize). Keeps the
         host->device transfer small — the TPU analog of the reference doing
-        mean-subtract inside the C++ iterator (iter_normalize.h)."""
+        mean-subtract inside the C++ iterator (iter_normalize.h).
+
+        ``remat``: recompute activations during backward (jax.checkpoint),
+        trading FLOPs for HBM — the reference's gradient mirroring
+        (MXNET_BACKWARD_DO_MIRROR, graph_executor.cc mirror fn). Default
+        comes from that env var via mxnet_tpu.config."""
         self.net = net
         self.preprocess = preprocess
         self.loss_fn = _LOSSES[loss] if isinstance(loss, str) else loss
@@ -137,10 +151,17 @@ class TrainStep:
         self.compute_dtype = compute_dtype
         self._num_update = 0
 
+        if remat is None:
+            from .. import config as _config
+            remat = _config.get("MXNET_BACKWARD_DO_MIRROR")
+        self.remat = bool(remat)
+
         self.param_list = net._get_param_list()
         self._trainable = [p.grad_req != "null" for p in self.param_list]
         # staged forward in training mode: fn(pvals, args, key)->(outs,writes)
         _, self._staged = net._build_jit(training=True)
+        if self.remat:
+            self._staged = _remat_staged(self._staged)
         self._pvals = None
         self._opt_state = None
         self._step_jit = None
@@ -178,6 +199,11 @@ class TrainStep:
                 for st, s, v in zip(opt_state, shard, pvals))
         self._pvals = pvals
         self._opt_state = opt_state
+        t0 = jnp.zeros((), jnp.uint32)
+        if self.mesh is not None:
+            t0 = jax.device_put(t0, NamedSharding(self.mesh, P()))
+        self._t_dev = t0
+        self._lr_cache = None
 
     def _build_step(self):
         staged = self._staged
@@ -218,8 +244,8 @@ class TrainStep:
                 fwd, has_aux=True)(pvals)
             # optimizer update on trainable params only
             new_p, new_s = [], []
-            for p, g, s, t in zip(pvals, grads, opt_state, trainable):
-                if t:
+            for p, g, s, tr in zip(pvals, grads, opt_state, trainable):
+                if tr:
                     np_, ns_ = opt_update(p, g, s, lr)
                     new_p.append(np_.astype(p.dtype))
                     new_s.append(ns_)
@@ -227,16 +253,20 @@ class TrainStep:
                     new_p.append(p)
                     new_s.append(s)
             # fold BatchNorm running-stat writes (identified at trace time)
-            write_params = getattr(staged, "_write_params", [])
+            write_params = getattr(
+                getattr(staged, "_inner", staged), "_write_params", [])
             if write_params:
                 idx = {id(p): i for i, p in enumerate(param_objs)}
                 for wp, wv in zip(write_params, writes):
                     i = idx.get(id(wp))
                     if i is not None:
                         new_p[i] = wv.astype(new_p[i].dtype)
-            return tuple(new_p), tuple(new_s), loss
+            # the update counter lives ON DEVICE and advances inside the
+            # step: feeding it from the host would cost one tiny transfer
+            # (a full RPC when the chip is tunneled) every step
+            return tuple(new_p), tuple(new_s), t + 1, loss
 
-        donate = (0, 1)
+        donate = (0, 1, 4)
         if self.mesh is not None:
             rep = NamedSharding(self.mesh, P())
             batch1 = NamedSharding(self.mesh, P(self.data_axis))
@@ -256,7 +286,7 @@ class TrainStep:
             # pin outputs to the same layout: without this GSPMD may pick a
             # different sharding for the updated params, forcing a reshard
             # of every parameter on every step's input boundary
-            out_shardings = (pshard, sshard, rep)
+            out_shardings = (pshard, sshard, rep, rep)
             self._step_jit = jax.jit(step_fn, donate_argnums=donate,
                                      in_shardings=in_shardings,
                                      out_shardings=out_shardings)
@@ -295,10 +325,13 @@ class TrainStep:
             ya = jax.device_put(ya, batch)
         lr = self.lr if self.lr_schedule is None \
             else self.lr_schedule(self._num_update)
-        self._pvals, self._opt_state, loss = self._step_jit(
-            self._pvals, self._opt_state, xa, ya,
-            jnp.asarray(self._num_update, jnp.uint32),
-            jnp.asarray(lr, jnp.float32))
+        # cache the lr device scalar (it changes rarely; shipping a fresh
+        # host scalar per step costs a transfer round trip)
+        if self._lr_cache is None or self._lr_cache[0] != lr:
+            self._lr_cache = (lr, jnp.asarray(lr, jnp.float32))
+        self._pvals, self._opt_state, self._t_dev, loss = self._step_jit(
+            self._pvals, self._opt_state, xa, ya, self._t_dev,
+            self._lr_cache[1])
         self._num_update += 1
         return _wrap(loss)
 

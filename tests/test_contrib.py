@@ -191,3 +191,136 @@ class TestModelStore:
         kb = sorted(b.keys())[0]
         np.testing.assert_allclose(a[k].data().asnumpy(),
                                    b[kb].data().asnumpy())
+
+
+class TestOnnxImport:
+    """Converter exercised with duck-typed GraphProto objects — the op
+    mapping is the capability; .onnx protobuf parsing needs the onnx pkg
+    (reference: contrib/onnx/_import/import_onnx.py)."""
+
+    @staticmethod
+    def _graph():
+        class Attr:
+            def __init__(self, name, **kw):
+                self.name = name
+                for k, v in kw.items():
+                    setattr(self, k, v)
+
+        class Tensor:
+            def __init__(self, name, array):
+                self.name = name
+                self.array = array
+                self.dims = array.shape
+
+        class Node:
+            def __init__(self, op_type, inputs, outputs, name="", attrs=()):
+                self.op_type = op_type
+                self.input = inputs
+                self.output = outputs
+                self.name = name
+                self.attribute = attrs
+
+        class Graph:
+            pass
+
+        rng = np.random.RandomState(0)
+        w1 = rng.randn(8, 6).astype(np.float32)     # (units, in): transB=1
+        b1 = np.zeros(8, np.float32)
+        w2 = rng.randn(8, 3).astype(np.float32)     # transB=0: needs .T
+        b2 = np.zeros(3, np.float32)
+        g = Graph()
+        g.node = [
+            Node("Gemm", ["x", "w1", "b1"], ["h"], "gemm1",
+                 (Attr("transB", i=1),)),
+            Node("Relu", ["h"], ["hr"], "relu1"),
+            Node("Gemm", ["hr", "w2", "b2"], ["logits"], "gemm2",
+                 (Attr("transB", i=0),)),
+            Node("Softmax", ["logits"], ["prob"], "softmax",
+                 (Attr("axis", i=1),)),
+        ]
+        g.input = ["x", "w1", "b1", "w2", "b2"]
+        g.output = ["prob"]
+        g.initializer = [Tensor("w1", w1), Tensor("b1", b1),
+                         Tensor("w2", w2), Tensor("b2", b2)]
+        return g, w1, b1, w2, b2
+
+    def test_import_mlp_and_run(self):
+        from mxnet_tpu.contrib.onnx import import_onnx_graph
+        g, w1, b1, w2, b2 = self._graph()
+        sym, arg_params, aux_params = import_onnx_graph(g)
+        assert "x" in sym.list_arguments()
+        exe = sym.simple_bind(mx.cpu(), x=(2, 6))
+        for k, v in arg_params.items():
+            if k in exe.arg_dict:
+                exe.arg_dict[k][:] = v.asnumpy()
+        x = np.random.RandomState(1).randn(2, 6).astype(np.float32)
+        exe.arg_dict["x"][:] = x
+        out = exe.forward(is_train=False)[0].asnumpy()
+        # numpy reference
+        h = np.maximum(x @ w1.T + b1, 0)
+        logits = h @ w2 + b2
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        expect = e / e.sum(1, keepdims=True)
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+    def test_unmapped_op_raises(self):
+        from mxnet_tpu.contrib.onnx import import_onnx_graph
+        g, *_ = self._graph()
+
+        class Node:
+            op_type = "NonexistentOp"
+            input = ["x"]
+            output = ["y"]
+            name = "bad"
+            attribute = ()
+        g.node = [Node()]
+        g.output = ["y"]
+        try:
+            import_onnx_graph(g)
+            assert False
+        except NotImplementedError as e:
+            assert "NonexistentOp" in str(e)
+
+    def test_import_model_requires_onnx_pkg(self):
+        from mxnet_tpu.contrib.onnx import import_model
+        try:
+            import onnx  # noqa: F401
+        except ImportError:
+            try:
+                import_model("/nonexistent.onnx")
+                assert False
+            except ImportError as e:
+                assert "onnx" in str(e)
+
+
+class TestConfig:
+    def test_registered_defaults_and_env_override(self, monkeypatch):
+        from mxnet_tpu import config
+        assert config.get("MXNET_KVSTORE_BIGARRAY_BOUND") == 1000000
+        monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "42")
+        assert config.get("MXNET_KVSTORE_BIGARRAY_BOUND") == 42
+        monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "true")
+        assert config.get("MXNET_BACKWARD_DO_MIRROR") is True
+
+    def test_show_table(self, capsys):
+        from mxnet_tpu import config
+        config.show()
+        out = capsys.readouterr().out
+        assert "MXNET_ENGINE_TYPE" in out
+
+    def test_remat_step_trains(self):
+        # gradient mirroring: jax.checkpoint path numerically matches
+        import numpy as np
+        from mxnet_tpu.parallel import TrainStep
+        x = np.random.RandomState(0).randn(8, 12).astype(np.float32)
+        y = np.random.RandomState(1).randint(0, 4, (8,))
+        losses = {}
+        for remat in (False, True):
+            mx.random.seed(11)
+            net = nn.HybridSequential(prefix=f"remat{remat}_")
+            with net.name_scope():
+                net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+            net.initialize(mx.init.Xavier())
+            step = TrainStep(net, lr=0.05, remat=remat)
+            losses[remat] = [float(step(x, y).asscalar()) for _ in range(3)]
+        np.testing.assert_allclose(losses[False], losses[True], rtol=1e-5)
