@@ -15,9 +15,7 @@ from typing import Any, Callable, Dict
 __all__ = ["get", "register", "show", "variables"]
 
 
-def _bool(v: str) -> bool:
-    return v.lower() in ("1", "true", "yes", "on")
-
+from .base import get_env as _get_env
 
 _REGISTRY: Dict[str, tuple] = {}
 
@@ -30,15 +28,13 @@ def register(name: str, default, typ: Callable = str, doc: str = ""):
 
 def get(name: str, default=None):
     """Read a registered variable from the environment (typed), or the
-    registered default (reference: dmlc::GetEnv)."""
+    registered default — built on base.get_env so the truth table for
+    booleans is uniform everywhere (reference: dmlc::GetEnv)."""
     if name in _REGISTRY:
         reg_default, typ, _ = _REGISTRY[name]
-        raw = os.environ.get(name)
-        if raw is None:
-            return default if default is not None else reg_default
-        return typ(raw) if typ is not bool else _bool(raw)
-    raw = os.environ.get(name)
-    return raw if raw is not None else default
+        eff_default = default if default is not None else reg_default
+        return _get_env(name, eff_default, dtype=typ)
+    return _get_env(name, default)
 
 
 def variables():

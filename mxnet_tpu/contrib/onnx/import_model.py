@@ -69,11 +69,23 @@ def _tensor_to_np(t):
     raise ValueError(f"cannot decode ONNX tensor {getattr(t, 'name', t)!r}")
 
 
+def _sym_pads(attrs, ndim, op_name):
+    """ONNX pads are (begin..., end...); the op surface takes one symmetric
+    value per spatial dim — reject silent truncation of asymmetric pads."""
+    pads = tuple(attrs.get("pads", (0,) * 2 * ndim))
+    begin, end = pads[:ndim], pads[ndim:]
+    if tuple(begin) != tuple(end):
+        raise NotImplementedError(
+            f"{op_name}: asymmetric ONNX pads {pads} are not supported "
+            "(symmetric begin==end only)")
+    return begin
+
+
 def _pool_attrs(attrs, pool_type):
     kernel = tuple(attrs.get("kernel_shape", (1, 1)))
     stride = tuple(attrs.get("strides", (1,) * len(kernel)))
-    pads = tuple(attrs.get("pads", (0,) * 2 * len(kernel)))
-    return dict(kernel=kernel, stride=stride, pad=pads[:len(kernel)],
+    return dict(kernel=kernel, stride=stride,
+                pad=_sym_pads(attrs, len(kernel), pool_type + "Pool"),
                 pool_type=pool_type)
 
 
@@ -112,16 +124,25 @@ def import_onnx_graph(graph):
             out = sym_mod.Convolution(
                 *ins, kernel=kernel,
                 stride=tuple(attrs.get("strides", (1,) * len(kernel))),
-                pad=tuple(attrs.get("pads", (0,) * 2 * len(kernel)))[:len(kernel)],
+                pad=_sym_pads(attrs, len(kernel), "Conv"),
                 dilate=tuple(attrs.get("dilations", (1,) * len(kernel))),
                 num_filter=params[node.input[1]].shape[0],
                 num_group=int(attrs.get("group", 1)),
                 no_bias=len(ins) < 3, name=name)
         elif op == "Gemm":
+            if attrs.get("transA", 0):
+                raise NotImplementedError("Gemm: transA=1 is not supported")
+            alpha = float(attrs.get("alpha", 1.0))
+            beta = float(attrs.get("beta", 1.0))
             w = params[node.input[1]]
             if not attrs.get("transB", 0):
                 # our FullyConnected wants (units, in); transpose stored W
-                params[node.input[1]] = np.ascontiguousarray(w.T)
+                w = np.ascontiguousarray(w.T)
+            if alpha != 1.0:
+                w = w * alpha            # fold alpha into the weight
+            params[node.input[1]] = w
+            if len(node.input) > 2 and beta != 1.0:
+                params[node.input[2]] = params[node.input[2]] * beta
             out = sym_mod.FullyConnected(
                 *ins, num_hidden=params[node.input[1]].shape[0],
                 no_bias=len(ins) < 3, name=name)
@@ -146,6 +167,12 @@ def import_onnx_graph(graph):
                 *ins, eps=float(attrs.get("epsilon", 1e-5)),
                 momentum=float(attrs.get("momentum", 0.9)),
                 fix_gamma=False, name=name)
+            # running mean/var are auxiliary states: mark their variable
+            # nodes so list_auxiliary_states()/bind load them from
+            # aux_params (reference: from_onnx aux handling)
+            for aux_in in node.input[3:5]:
+                if aux_in in tensors:
+                    tensors[aux_in]._node.attrs["__is_aux__"] = True
             aux_names.extend(node.input[3:5])
         elif op == "Add":
             out = sym_mod.broadcast_add(*ins, name=name)
@@ -184,14 +211,27 @@ def import_onnx_graph(graph):
             tensors[node.output[0]] = sym_var(node.output[0])
             continue
         elif op == "Pad":
+            # ONNX pads = (begin_0..begin_n, end_0..end_n); the Pad op's
+            # pad_width interleaves (begin, end) per axis
             pads = tuple(attrs.get("pads", ()))
+            half = len(pads) // 2
+            interleaved = tuple(
+                v for i in range(half) for v in (pads[i], pads[half + i]))
             out = sym_mod.Pad(ins[0], mode=attrs.get("mode", "constant"),
-                              pad_width=pads, name=name)
+                              pad_width=interleaved, name=name)
         elif op == "Clip":
-            out = sym_mod.clip(ins[0],
-                               a_min=float(attrs.get("min", -np.inf)),
-                               a_max=float(attrs.get("max", np.inf)),
-                               name=name)
+            # opset >= 11 passes min/max as inputs 1-2 (constant tensors)
+            a_min = float(attrs.get("min", -np.inf))
+            a_max = float(attrs.get("max", np.inf))
+            extra = [n for n in node.input[1:] if n]
+            if extra:
+                vals = [float(np.asarray(params.pop(n)).reshape(()))
+                        for n in extra if n in params]
+                if len(vals) >= 1:
+                    a_min = vals[0]
+                if len(vals) >= 2:
+                    a_max = vals[1]
+            out = sym_mod.clip(ins[0], a_min=a_min, a_max=a_max, name=name)
         else:
             raise NotImplementedError(
                 f"ONNX op {op!r} is not mapped (reference coverage: "

@@ -324,3 +324,131 @@ class TestConfig:
             step = TrainStep(net, lr=0.05, remat=remat)
             losses[remat] = [float(step(x, y).asscalar()) for _ in range(3)]
         np.testing.assert_allclose(losses[False], losses[True], rtol=1e-5)
+
+
+class _OnnxAttr:
+    def __init__(self, name, **kw):
+        self.name = name
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+class _OnnxTensor:
+    def __init__(self, name, array):
+        self.name = name
+        self.array = np.asarray(array)
+        self.dims = self.array.shape
+
+
+class _OnnxNode:
+    def __init__(self, op_type, ins, outs, name="", attrs=()):
+        self.op_type = op_type
+        self.input = ins
+        self.output = outs
+        self.name = name
+        self.attribute = attrs
+
+
+class TestOnnxImportDetails:
+    """Regression tests for the importer's attribute handling."""
+
+    @staticmethod
+    def _mk(nodes, inputs, outputs, initializers):
+        class Graph:
+            pass
+        g = Graph()
+        g.node = [_OnnxNode(*n[:3], **(n[3] if len(n) > 3 else {}))
+                  for n in nodes]
+        g.input = inputs
+        g.output = outputs
+        g.initializer = [_OnnxTensor(k, v) for k, v in initializers.items()]
+        return g, _OnnxAttr
+
+    def test_batchnorm_running_stats_are_aux(self):
+        from mxnet_tpu.contrib.onnx import import_onnx_graph
+        Attr = _OnnxAttr
+        g, _ = self._mk(
+            [("BatchNormalization", ["x", "g", "b", "m", "v"], ["y"], {
+                "name": "bn",
+                "attrs": (Attr("epsilon", f=1e-5),)})],
+            ["x", "g", "b", "m", "v"], ["y"],
+            {"g": np.ones(3, np.float32), "b": np.zeros(3, np.float32),
+             "m": np.full(3, 2.0, np.float32),
+             "v": np.full(3, 4.0, np.float32)})
+        sym, args, aux = import_onnx_graph(g)
+        assert set(aux.keys()) == {"m", "v"}
+        assert set(sym.list_auxiliary_states()) == {"m", "v"}
+        exe = sym.simple_bind(mx.cpu(), x=(2, 3, 4, 4))
+        for k, v in args.items():
+            if k in exe.arg_dict:
+                exe.arg_dict[k][:] = v.asnumpy()
+        for k, v in aux.items():
+            exe.aux_dict[k][:] = v.asnumpy()
+        x = np.random.RandomState(0).randn(2, 3, 4, 4).astype(np.float32)
+        exe.arg_dict["x"][:] = x
+        out = exe.forward(is_train=False)[0].asnumpy()
+        expect = (x - 2.0) / np.sqrt(4.0 + 1e-5)
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-4)
+
+    def test_pad_interleaving(self):
+        from mxnet_tpu.contrib.onnx import import_onnx_graph
+        Attr = _OnnxAttr
+        g, _ = self._mk(
+            [("Pad", ["x"], ["y"], {
+                "name": "pad",
+                "attrs": (Attr("pads", ints=(0, 0, 1, 1, 0, 0, 1, 1)),
+                          Attr("mode", s="constant"))})],
+            ["x"], ["y"], {})
+        sym, args, _ = import_onnx_graph(g)
+        exe = sym.simple_bind(mx.cpu(), x=(1, 2, 3, 3))
+        exe.arg_dict["x"][:] = np.ones((1, 2, 3, 3), np.float32)
+        out = exe.forward(is_train=False)[0]
+        assert out.shape == (1, 2, 5, 5)   # H and W padded, not C
+
+    def test_clip_minmax_from_inputs(self):
+        from mxnet_tpu.contrib.onnx import import_onnx_graph
+        g, _ = self._mk(
+            [("Clip", ["x", "lo", "hi"], ["y"], {"name": "clip"})],
+            ["x"], ["y"],
+            {"lo": np.float32(0.0), "hi": np.float32(6.0)})
+        sym, args, _ = import_onnx_graph(g)
+        exe = sym.simple_bind(mx.cpu(), x=(4,))
+        exe.arg_dict["x"][:] = np.array([-1, 3, 7, 100], np.float32)
+        out = exe.forward(is_train=False)[0].asnumpy()
+        np.testing.assert_allclose(out, [0, 3, 6, 6])
+
+    def test_gemm_alpha_beta(self):
+        from mxnet_tpu.contrib.onnx import import_onnx_graph
+        w = np.ones((2, 3), np.float32)
+        b = np.ones(2, np.float32)
+        Attr = _OnnxAttr
+        g, _ = self._mk(
+            [("Gemm", ["x", "w", "b"], ["y"], {
+                "name": "gemm",
+                "attrs": (Attr("transB", i=1), Attr("alpha", f=0.5),
+                          Attr("beta", f=2.0))})],
+            ["x", "w", "b"], ["y"], {"w": w, "b": b})
+        sym, args, _ = import_onnx_graph(g)
+        exe = sym.simple_bind(mx.cpu(), x=(1, 3))
+        for k, v in args.items():
+            if k in exe.arg_dict:
+                exe.arg_dict[k][:] = v.asnumpy()
+        exe.arg_dict["x"][:] = np.ones((1, 3), np.float32)
+        out = exe.forward(is_train=False)[0].asnumpy()
+        np.testing.assert_allclose(out, [[3.5, 3.5]])  # 0.5*3 + 2*1
+
+    def test_asymmetric_pads_raise(self):
+        from mxnet_tpu.contrib.onnx import import_onnx_graph
+        w = np.ones((4, 3, 3, 3), np.float32)
+        Attr = _OnnxAttr
+        g, _ = self._mk(
+            [("Conv", ["x", "w"], ["y"], {
+                "name": "conv",
+                "attrs": (Attr("kernel_shape", ints=(3, 3)),
+                          Attr("pads", ints=(0, 0, 1, 1)))})],
+            ["x", "w"], ["y"], {"w": w})
+        try:
+            import_onnx_graph(g)
+            assert False
+        except NotImplementedError as e:
+            assert "asymmetric" in str(e)
