@@ -15,5 +15,7 @@ collectives over a ``jax.sharding.Mesh``:
 """
 from .mesh import make_mesh, current_mesh, set_default_mesh
 from .step import TrainStep
+from .ring import ring_attention, sequence_shard
 
-__all__ = ["make_mesh", "current_mesh", "set_default_mesh", "TrainStep"]
+__all__ = ["make_mesh", "current_mesh", "set_default_mesh", "TrainStep",
+           "ring_attention", "sequence_shard"]
