@@ -74,6 +74,9 @@ register("MXNET_ENGINE_TYPE", "XLA", str,
 register("MXNET_EXEC_BULK_EXEC_TRAIN", True, bool,
          "Whole-step fusion — informational; jit fuses the full step "
          "(env_var.md:62)")
+register("MXNET_USE_NATIVE_IO", True, bool,
+         "Use the C++ RecordIO reader (native/libmxtpu_io.so, built on "
+         "first use) instead of the pure-Python parser")
 register("MXNET_BACKWARD_DO_MIRROR", False, bool,
          "Recompute activations in backward (jax.checkpoint) to trade "
          "FLOPs for memory (env_var.md:93)")

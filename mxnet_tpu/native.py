@@ -1,0 +1,146 @@
+"""ctypes binding for the native IO library (``native/libmxtpu_io.so``).
+
+The runtime around the XLA compute path is native where the reference's is
+(reference: src/io/ C++ iterators behind the C API): RecordIO parsing,
+zero-copy record access and background prefetch live in
+``native/recordio.cc``. The library is built on first use with the
+in-image toolchain (``make -C native``); every consumer falls back to the
+pure-Python implementation when the toolchain or build is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+__all__ = ["get_lib", "NativeRecordReader", "available"]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libmxtpu_io.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    subprocess.run(["make", "-C", _NATIVE_DIR, "-s"], check=True,
+                   capture_output=True)
+
+
+def get_lib():
+    """Load (building if needed) the native library, or None."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_LIB_PATH):
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+        except Exception:
+            return None
+        lib.rio_open.restype = ctypes.c_void_p
+        lib.rio_open.argtypes = [ctypes.c_char_p]
+        lib.rio_count.restype = ctypes.c_int64
+        lib.rio_count.argtypes = [ctypes.c_void_p]
+        lib.rio_record_len.restype = ctypes.c_int64
+        lib.rio_record_len.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.rio_record_ptr.restype = ctypes.c_void_p
+        lib.rio_record_ptr.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.rio_record_copy.restype = ctypes.c_int
+        lib.rio_record_copy.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                        ctypes.c_void_p]
+        lib.rio_record_offset.restype = ctypes.c_int64
+        lib.rio_record_offset.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.rio_error.restype = ctypes.c_char_p
+        lib.rio_error.argtypes = [ctypes.c_void_p]
+        lib.rio_prefetch_start.restype = ctypes.c_int
+        lib.rio_prefetch_start.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64]
+        lib.rio_prefetch_next.restype = ctypes.c_int64
+        lib.rio_prefetch_next.argtypes = [ctypes.c_void_p]
+        lib.rio_prefetch_stop.argtypes = [ctypes.c_void_p]
+        lib.rio_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+class NativeRecordReader:
+    """Random-access RecordIO reader over the native library.
+
+    Indexes the whole file once (mmap, O(n) scan), then serves records
+    by ordinal with zero-copy for single-segment records. ``prefetch``
+    starts the C++ readahead thread over an epoch's access order
+    (reference analog: iter_prefetcher.h + dmlc::ThreadedIter)."""
+
+    def __init__(self, path):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native IO library unavailable")
+        self._lib = lib
+        self._h = lib.rio_open(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open {path}")
+        err = lib.rio_error(self._h)
+        if err:
+            msg = err.decode()
+            if msg:
+                lib.rio_close(self._h)
+                self._h = None
+                raise IOError(f"{path}: {msg}")
+
+    def __len__(self):
+        return int(self._lib.rio_count(self._h))
+
+    def offset(self, idx) -> int:
+        """Byte offset of record ``idx``'s header (for .idx files)."""
+        off = self._lib.rio_record_offset(self._h, idx)
+        if off < 0:
+            raise IndexError(idx)
+        return int(off)
+
+    def read(self, idx) -> bytes:
+        n = self._lib.rio_record_len(self._h, idx)
+        if n < 0:
+            raise IndexError(idx)
+        ptr = self._lib.rio_record_ptr(self._h, idx)
+        if ptr:
+            return ctypes.string_at(ptr, n)
+        buf = ctypes.create_string_buffer(int(n))
+        if self._lib.rio_record_copy(self._h, idx, buf) != 0:
+            raise IndexError(idx)
+        return buf.raw
+
+    def prefetch(self, order, capacity=64):
+        arr = (ctypes.c_int64 * len(order))(*order)
+        if self._lib.rio_prefetch_start(self._h, arr, len(order),
+                                        capacity) != 0:
+            raise RuntimeError("prefetch already running")
+
+    def prefetch_next(self) -> Optional[int]:
+        idx = self._lib.rio_prefetch_next(self._h)
+        return None if idx < 0 else int(idx)
+
+    def prefetch_stop(self):
+        self._lib.rio_prefetch_stop(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.rio_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

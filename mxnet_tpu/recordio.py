@@ -48,9 +48,36 @@ class MXRecordIO:
         elif self.flag == "r":
             self.handle = open(self.uri, "rb")
             self.writable = False
+            self._open_native()
         else:
             raise ValueError("Invalid flag %s" % self.flag)
         self.is_open = True
+
+    _native = None
+    _native_by_offset = None
+
+    def _open_native(self):
+        """Use the C++ reader (mmap index + zero-copy records) when the
+        native library builds; the pure-Python path below stays the
+        fallback (reference analog: the C++ src/io/ iterators vs the
+        python recordio module). The file handle's position remains the
+        single source of truth, so seek()/tell()/read() keep the exact
+        reference semantics on both paths."""
+        from . import config
+        self._native = None
+        self._native_by_offset = None
+        if not config.get("MXNET_USE_NATIVE_IO"):
+            return
+        try:
+            from .native import NativeRecordReader, available
+            if available():
+                self._native = NativeRecordReader(self.uri)
+                self._native_by_offset = {
+                    self._native.offset(i): i
+                    for i in range(len(self._native))}
+        except Exception:
+            self._native = None
+            self._native_by_offset = None
 
     def __del__(self):
         self.close()
@@ -75,11 +102,19 @@ class MXRecordIO:
     def close(self):
         if not self.is_open:
             return
+        if self._native is not None:
+            self._native.close()
+            self._native = None
         self.handle.close()
         self.is_open = False
 
     def reset(self):
         """(reference: recordio.py:122)"""
+        if not self.writable and self.is_open:
+            # readers just rewind — rebuilding the native reader would
+            # re-mmap and re-index the whole file every epoch
+            self.handle.seek(0)
+            return
         self.close()
         self.open()
 
@@ -109,6 +144,19 @@ class MXRecordIO:
     def read(self):
         """Read one record, None at EOF (reference: recordio.py:150)."""
         assert not self.writable
+        if self._native is not None:
+            pos = self.handle.tell()
+            ordinal = self._native_by_offset.get(pos)
+            if ordinal is not None:
+                buf = self._native.read(ordinal)
+                nxt = ordinal + 1
+                if nxt < len(self._native):
+                    self.handle.seek(self._native.offset(nxt))
+                else:
+                    self.handle.seek(0, 2)        # EOF
+                return buf
+            # EOF or a position that is not a record boundary: fall through
+            # to the python parser (raises on corruption, None at EOF)
         header = self.handle.read(8)
         if len(header) < 8:
             return None
