@@ -53,6 +53,7 @@ from . import monitor as mon
 from . import profiler
 from . import rtc
 from . import config
+from . import engine
 from . import visualization
 from . import visualization as viz
 from . import contrib

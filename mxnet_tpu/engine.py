@@ -1,0 +1,33 @@
+"""Engine control facade (reference: python/mxnet/engine.py — bulk scope;
+native src/engine/).
+
+The reference's dependency engine batches op pushes under ``bulk(size)``
+to amortize scheduling overhead (MXNET_EXEC_BULK_EXEC_*). Under XLA the
+whole jitted step is already one fused computation, so bulking is
+subsumed; the API is kept for source compatibility and records the
+requested size for introspection.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["bulk", "set_bulk_size"]
+
+_bulk_size = [0]
+
+
+def set_bulk_size(size):
+    """(reference: engine.py set_bulk_size). Returns the previous size."""
+    prev, _bulk_size[0] = _bulk_size[0], int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Scope hint for engine op bulking (reference: engine.py bulk).
+    A no-op under XLA — jit already executes the region as one program."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -657,3 +657,120 @@ def multi_proposal(cls_prob, bbox_pred, im_info, **kw):
     same math as Proposal over every image)."""
     kw.pop("output_score", None)
     return proposal(cls_prob, bbox_pred, im_info, output_score=False, **kw)
+
+
+# ---------------------------------------------------------------------------
+# count_sketch (reference: src/operator/contrib/count_sketch-inl.h:47 —
+# compact bilinear pooling building block)
+# ---------------------------------------------------------------------------
+@register_op("count_sketch", aliases=["_contrib_count_sketch"])
+def count_sketch(data, h, s, out_dim=None, processing_batch_size=32, **kw):
+    """Count sketch projection: out[n, h[i]] += s[i] * data[n, i].
+
+    data: (n, in_dim); h: (1, in_dim) int hash bucket per input dim;
+    s: (1, in_dim) signs in {-1, +1}. Output (n, out_dim). The scatter-add
+    maps to one segment_sum; gradients come from autodiff (the reference
+    hand-writes the mirrored gather kernel)."""
+    if out_dim is None:
+        raise ValueError("count_sketch requires out_dim")
+    out_dim = int(out_dim)
+    n, in_dim = data.shape
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1).astype(data.dtype)
+    signed = data * ss[None, :]
+    out = jax.ops.segment_sum(signed.T, hh, num_segments=out_dim)  # (out, n)
+    return out.T
+
+
+# ---------------------------------------------------------------------------
+# Deformable convolution (DCN v1; reference:
+# src/operator/contrib/deformable_convolution-inl.h,
+# nn/deformable_im2col.cuh:216-260 — offset layout [dg][2*(i*Kw+j)] with the
+# h-offset first, sample = (h_in + i*dil + off_h, w_in + j*dil + off_w),
+# zero outside the image)
+# ---------------------------------------------------------------------------
+def _bilinear_sample_chw(img, ys, xs):
+    """Bilinear sample a (C, H, W) image at float positions ys/xs (...,).
+    Out-of-image points and out-of-range corners contribute zero, matching
+    the reference kernel's bounds checks."""
+    C, H, W = img.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    dy = ys - y0
+    dx = xs - x0
+    out = 0.0
+    for cy, wy in ((y0, 1 - dy), (y0 + 1, dy)):
+        for cx, wx in ((x0, 1 - dx), (x0 + 1, dx)):
+            valid = (cy >= 0) & (cy < H) & (cx >= 0) & (cx < W)
+            yi = jnp.clip(cy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(cx, 0, W - 1).astype(jnp.int32)
+            v = img[:, yi, xi]                        # (C, ...)
+            out = out + jnp.where(valid, wy * wx, 0.0) * v
+    # no whole-point mask: the reference guard is h_im > -1 (partial
+    # bilinear contributions at the border), which the per-corner checks
+    # above reproduce exactly
+    return out                                        # (C, ...)
+
+
+def _deform_conv_one(data, offset, weight, kernel, stride, dilate, pad,
+                     num_group, num_deformable_group):
+    """Single-sample deformable conv: data (C,H,W), offset (2*dg*Kh*Kw,
+    oh,ow), weight (F, C/g, Kh, Kw)."""
+    C, H, W = data.shape
+    kh, kw = kernel
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+    oh = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    dg = num_deformable_group
+    off = offset.reshape(dg, kh * kw, 2, oh, ow)
+    h_in = jnp.arange(oh) * sh - ph
+    w_in = jnp.arange(ow) * sw - pw
+
+    cpg = C // dg                                    # channels per dg
+    cols = []
+    for tap in range(kh * kw):
+        i, j = tap // kw, tap % kw
+        tap_cols = []
+        for g in range(dg):
+            ys = h_in[:, None] + i * dh + off[g, tap, 0]
+            xs = w_in[None, :] + j * dw + off[g, tap, 1]
+            sampled = _bilinear_sample_chw(
+                data[g * cpg:(g + 1) * cpg], ys, xs)   # (cpg, oh, ow)
+            tap_cols.append(sampled)
+        cols.append(jnp.concatenate(tap_cols, axis=0))  # (C, oh, ow)
+    col = jnp.stack(cols, axis=1)                       # (C, Kh*Kw, oh, ow)
+
+    F = weight.shape[0]
+    cg = C // num_group
+    fg = F // num_group
+    outs = []
+    for g in range(num_group):
+        w_g = weight[g * fg:(g + 1) * fg].reshape(fg, cg * kh * kw)
+        c_g = col[g * cg:(g + 1) * cg].reshape(cg * kh * kw, oh * ow)
+        outs.append((w_g @ c_g).reshape(fg, oh, ow))
+    return jnp.concatenate(outs, axis=0)                # (F, oh, ow)
+
+
+@register_op("DeformableConvolution",
+             aliases=["_contrib_DeformableConvolution"])
+def deformable_convolution(data, offset, weight, bias=None, kernel=None,
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=0, num_group=1,
+                           num_deformable_group=1, no_bias=False, **kw):
+    """Deformable convolution: sampling locations shifted by learned
+    offsets. Gradients (data, offset, weight) all come from autodiff of
+    the bilinear sampling — the reference hand-writes three kernels
+    (deformable_col2im, _col2im_coord, im2col)."""
+    kernel = tuple(int(k) for k in _tuplef(kernel, (3, 3)))
+    stride = tuple(int(s) for s in _tuplef(stride, (1, 1)))
+    dilate = tuple(int(d) for d in _tuplef(dilate, (1, 1)))
+    pad = tuple(int(p) for p in _tuplef(pad, (0, 0)))
+    fn = lambda d, o: _deform_conv_one(d, o, weight, kernel, stride,
+                                       dilate, pad, int(num_group),
+                                       int(num_deformable_group))
+    out = jax.vmap(fn)(data, offset)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out

@@ -590,3 +590,12 @@ def spatial_transformer(data, loc, target_shape=(0, 0), transform_type="affine",
                         sampler_type="bilinear", cudnn_off=False, **kw):
     grid = grid_generator(loc, transform_type, target_shape)
     return bilinear_sampler(data, grid)
+
+
+# legacy v0.x interface names (reference: MXNET_REGISTER_OP_PROPERTY
+# batch_norm_v1 src/operator/batch_norm_v1.cc, convolution_v1, pooling_v1 —
+# same math behind the older Operator interface; here plain aliases)
+from .registry import alias as _alias
+_alias("BatchNorm", "BatchNorm_v1")
+_alias("Convolution", "Convolution_v1")
+_alias("Pooling", "Pooling_v1")

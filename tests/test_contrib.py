@@ -452,3 +452,67 @@ class TestOnnxImportDetails:
             assert False
         except NotImplementedError as e:
             assert "asymmetric" in str(e)
+
+
+class TestMiscParity:
+    def test_count_sketch(self):
+        rng = np.random.RandomState(0)
+        data = rng.randn(3, 10).astype(np.float32)
+        h = rng.randint(0, 6, (1, 10))
+        s = rng.choice([-1, 1], (1, 10)).astype(np.float32)
+        out = nd.count_sketch(nd.array(data), nd.array(h), nd.array(s),
+                              out_dim=6)
+        ref = np.zeros((3, 6), np.float32)
+        for i in range(10):
+            ref[:, h[0, i]] += s[0, i] * data[:, i]
+        np.testing.assert_allclose(out.asnumpy(), ref, atol=1e-5)
+
+    def test_count_sketch_grad(self):
+        rng = np.random.RandomState(1)
+        data = nd.array(rng.randn(2, 6).astype(np.float32))
+        h = nd.array(rng.randint(0, 4, (1, 6)))
+        s = nd.array(rng.choice([-1, 1], (1, 6)).astype(np.float32))
+        data.attach_grad()
+        with mx.autograd.record():
+            loss = nd.count_sketch(data, h, s, out_dim=4).sum()
+        loss.backward()
+        np.testing.assert_allclose(data.grad.asnumpy(),
+                                   np.broadcast_to(s.asnumpy(), (2, 6)),
+                                   atol=1e-6)
+
+    def test_legacy_v1_aliases(self):
+        x = nd.Pooling_v1(nd.ones((1, 2, 4, 4)), kernel=(2, 2),
+                          stride=(2, 2), pool_type="avg")
+        assert x.shape == (1, 2, 2, 2)
+        np.testing.assert_allclose(x.asnumpy(), 1.0)
+        sym = mx.sym.Convolution_v1(mx.sym.Variable("data"),
+                                    kernel=(3, 3), num_filter=4, pad=(1, 1),
+                                    name="conv")
+        exe = sym.simple_bind(mx.cpu(), data=(1, 3, 8, 8))
+        assert exe.forward(is_train=False)[0].shape == (1, 4, 8, 8)
+
+    def test_engine_bulk_scope(self):
+        prev = mx.engine.set_bulk_size(0)
+        with mx.engine.bulk(16):
+            y = nd.ones((2, 2)) + 1
+        np.testing.assert_allclose(y.asnumpy(), 2.0)
+        mx.engine.set_bulk_size(prev)
+
+    def test_launch_py_spawns_workers(self, tmp_path):
+        import subprocess
+        import sys
+        import pathlib
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os\n"
+            "assert 'COORDINATOR_ADDRESS' in os.environ\n"
+            "print('rank', os.environ['PROCESS_ID'])\n")
+        launcher = (pathlib.Path(__file__).parent.parent / "tools"
+                    / "launch.py")
+        out = subprocess.run(
+            [sys.executable, str(launcher), "-n", "2",
+             sys.executable, str(script)],
+            capture_output=True, timeout=60)
+        assert out.returncode == 0, out.stderr.decode()
+        text = out.stdout.decode()
+        assert "rank 0" in text and "rank 1" in text

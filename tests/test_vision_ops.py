@@ -175,3 +175,87 @@ class TestProposal:
                                 feature_stride=16).asnumpy()
         assert rois.shape == (8, 5)
         assert (rois[:4, 0] == 0).all() and (rois[4:, 0] == 1).all()
+
+
+class TestDeformableConvolution:
+    def test_zero_offset_equals_convolution(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 4, 8, 8).astype(np.float32)
+        w = rng.randn(6, 4, 3, 3).astype(np.float32)
+        b = np.zeros(6, np.float32)
+        off = np.zeros((2, 18, 8, 8), np.float32)
+        out_d = nd.DeformableConvolution(
+            nd.array(x), nd.array(off), nd.array(w), nd.array(b),
+            kernel=(3, 3), pad=(1, 1), num_filter=6)
+        out_c = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                               kernel=(3, 3), pad=(1, 1), num_filter=6)
+        np.testing.assert_allclose(out_d.asnumpy(), out_c.asnumpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_integer_shift(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 4, 8, 8).astype(np.float32)
+        w = rng.randn(6, 4, 3, 3).astype(np.float32)
+        b = np.zeros(6, np.float32)
+        off = np.zeros((2, 18, 6, 6), np.float32)
+        off[:, 1::2] = 1.0                       # shift x-samples by +1
+        out = nd.DeformableConvolution(
+            nd.array(x), nd.array(off), nd.array(w), nd.array(b),
+            kernel=(3, 3), pad=(0, 0), num_filter=6)
+        xs = np.zeros_like(x)
+        xs[:, :, :, :-1] = x[:, :, :, 1:]
+        ref = nd.Convolution(nd.array(xs), nd.array(w), nd.array(b),
+                             kernel=(3, 3), pad=(0, 0), num_filter=6)
+        np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_fractional_offsets_vs_naive(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(1, 2, 6, 6).astype(np.float32)
+        w = rng.randn(3, 2, 3, 3).astype(np.float32)
+        b = np.zeros(3, np.float32)
+        off = (rng.rand(1, 18, 4, 4) * 2 - 1).astype(np.float32)
+        out = nd.DeformableConvolution(
+            nd.array(x), nd.array(off), nd.array(w), nd.array(b),
+            kernel=(3, 3), pad=(0, 0), num_filter=3).asnumpy()
+        # naive oracle following the reference kernel's sampling rule
+        ref = np.zeros((1, 3, 4, 4), np.float32)
+        offr = off.reshape(1, 9, 2, 4, 4)
+        for f in range(3):
+            for hc in range(4):
+                for wc in range(4):
+                    acc = 0.0
+                    for tap in range(9):
+                        i, j = tap // 3, tap % 3
+                        y = hc + i + offr[0, tap, 0, hc, wc]
+                        xq = wc + j + offr[0, tap, 1, hc, wc]
+                        # reference guard: h_im > -1 etc. — border points
+                        # keep their partial bilinear contribution
+                        if not (-1 < y < 6 and -1 < xq < 6):
+                            continue
+                        y0, x0 = int(np.floor(y)), int(np.floor(xq))
+                        dy, dx = y - y0, xq - x0
+                        for c in range(2):
+                            v = 0.0
+                            for (cy, wy) in ((y0, 1 - dy), (y0 + 1, dy)):
+                                for (cx, wx) in ((x0, 1 - dx), (x0 + 1, dx)):
+                                    if 0 <= cy < 6 and 0 <= cx < 6:
+                                        v += wy * wx * x[0, c, cy, cx]
+                            acc += w[f, c, i, j] * v
+                    ref[0, f, hc, wc] = acc
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_gradients_flow_to_offsets(self):
+        rng = np.random.RandomState(3)
+        xd = nd.array(rng.randn(1, 2, 6, 6).astype(np.float32))
+        xo = nd.array((rng.rand(1, 18, 4, 4) * 0.5).astype(np.float32))
+        w = nd.array(rng.randn(3, 2, 3, 3).astype(np.float32))
+        b = nd.zeros((3,))
+        xd.attach_grad()
+        xo.attach_grad()
+        with mx.autograd.record():
+            loss = nd.DeformableConvolution(
+                xd, xo, w, b, kernel=(3, 3), num_filter=3).sum()
+        loss.backward()
+        assert float(np.abs(xo.grad.asnumpy()).sum()) > 0
+        assert float(np.abs(xd.grad.asnumpy()).sum()) > 0

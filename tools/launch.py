@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Launch a multi-process distributed job on localhost.
+
+TPU-native rebuild of the reference cluster launcher (reference:
+tools/launch.py:31-54 — dmlc-tracker over ssh/mpi/yarn/sge bootstrapping
+DMLC_ROLE/DMLC_PS_ROOT_URI). There is no parameter-server role on TPU:
+every process is a worker in a jax.distributed job, so the launcher
+spawns N copies of the command with COORDINATOR_ADDRESS / NUM_PROCESSES /
+PROCESS_ID set (consumed by mxnet_tpu.parallel.dist.init). Multi-host
+clusters use the same env contract with your scheduler of choice.
+
+Usage: python tools/launch.py -n 4 python train.py --kv-store dist_sync
+"""
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def find_free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="launch a local N-process jax.distributed job")
+    parser.add_argument("-n", "--num-workers", type=int, required=True,
+                        help="number of worker processes")
+    parser.add_argument("--coordinator", default=None,
+                        help="host:port (default: localhost + free port)")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="command to run in every worker")
+    args = parser.parse_args()
+    if not args.command:
+        parser.error("no command given")
+
+    coordinator = args.coordinator or f"127.0.0.1:{find_free_port()}"
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update({
+            "COORDINATOR_ADDRESS": coordinator,
+            "NUM_PROCESSES": str(args.num_workers),
+            "PROCESS_ID": str(rank),
+        })
+        procs.append(subprocess.Popen(args.command, env=env))
+    # poll all workers: the first failure kills the rest (a crashed
+    # coordinator otherwise leaves siblings blocked in
+    # jax.distributed.initialize forever)
+    import time
+    rc = 0
+    live = dict(enumerate(procs))
+    while live:
+        for rank in list(live):
+            code = live[rank].poll()
+            if code is None:
+                continue
+            del live[rank]
+            if code != 0:
+                print(f"worker {rank} exited with {code}", file=sys.stderr)
+                rc = rc or code
+                for p in live.values():
+                    p.kill()
+                for p in live.values():
+                    p.wait()
+                live = {}
+                break
+        time.sleep(0.1)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
