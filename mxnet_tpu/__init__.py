@@ -54,6 +54,8 @@ from . import profiler
 from . import rtc
 from . import config
 from . import engine
+from . import runtime
+from . import kvstore_server
 from . import visualization
 from . import visualization as viz
 from . import contrib

@@ -1,0 +1,41 @@
+"""Parameter-server role shim (reference: python/mxnet/kvstore_server.py:28
+— the server main loop behind DMLC_ROLE=server).
+
+There is no server role on TPU: dist training is pure data parallelism
+over jax.distributed, and "update_on_kvstore" runs the optimizer on every
+process against the all-reduced gradient (mxnet_tpu/parallel/dist.py).
+Launch scripts that used to start servers get a clear explanation instead
+of a silent hang.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """(reference: kvstore_server.py:28). Not a runnable role on TPU."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+
+    def run(self):
+        raise RuntimeError(
+            "There is no parameter-server role on TPU: every process is a "
+            "worker; the server-side optimizer is the per-process updater "
+            "on the all-reduced gradient (see mxnet_tpu/parallel/dist.py "
+            "and tools/launch.py).")
+
+
+def _init_kvstore_server_module():
+    """(reference: kvstore_server.py:78 — called at import when
+    DMLC_ROLE=server). Kept for launch-script compatibility."""
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role in ("server", "scheduler"):
+        raise RuntimeError(
+            f"DMLC_ROLE={role!r} has no TPU equivalent: relaunch with "
+            "tools/launch.py (all processes are jax.distributed workers)")
+
+
+_init_kvstore_server_module()

@@ -134,9 +134,16 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return [(n, tuple(o.shape))
-                for n, o in zip(self._output_names, self._exec.outputs)] \
-            if self._exec.outputs else []
+        if self._exec.outputs:
+            return [(n, tuple(o.shape))
+                    for n, o in zip(self._output_names, self._exec.outputs)]
+        # before the first forward: infer from the bound input shapes
+        # (reference semantics — output_shapes is valid right after bind)
+        shapes = dict(self._data_shapes or [])
+        shapes.update(dict(self._label_shapes or []))
+        _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+        return list(zip(self._output_names,
+                        (tuple(s) for s in out_shapes)))
 
     # -- params ---------------------------------------------------------------
     def get_params(self):

@@ -1,0 +1,147 @@
+"""Python-defined modules (reference:
+python/mxnet/module/python_module.py:28 PythonModule, :240
+PythonLossModule)."""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .base_module import BaseModule
+
+__all__ = ["PythonModule", "PythonLossModule"]
+
+
+class PythonModule(BaseModule):
+    """Base for modules implemented directly in Python: most module APIs
+    default to no-ops; subclasses override the compute pieces
+    (reference: python_module.py:28)."""
+
+    def __init__(self, data_names, label_names, output_names, logger=logging):
+        super().__init__(logger=logger)
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._output_names = list(output_names or [])
+        self._data_shapes = None
+        self._label_shapes = None
+        self._output_shapes = None
+        self.binded = False
+        self.params_initialized = False
+        self.for_training = False
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._output_shapes
+
+    def get_params(self):
+        return {}, {}
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False,
+                    allow_extra=False):
+        self.params_initialized = True
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init,
+                         allow_extra=allow_extra)
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        pass
+
+    def update(self):
+        pass
+
+    def update_metric(self, eval_metric, labels):
+        if self._label_names:
+            eval_metric.update(labels, self.get_outputs())
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        self._data_shapes = [tuple(s) if not isinstance(s, tuple) else s
+                             for s in data_shapes]
+        self._label_shapes = label_shapes
+        self._output_shapes = self._compute_output_shapes()
+        self.binded = True
+
+    def _compute_output_shapes(self):
+        raise NotImplementedError
+
+
+class PythonLossModule(PythonModule):
+    """A loss implemented in Python: forward passes scores through,
+    backward produces d(loss)/d(scores) via ``grad_func`` (reference:
+    python_module.py:240)."""
+
+    def __init__(self, name="pyloss", data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 grad_func=None):
+        super().__init__(data_names, label_names, [name + "_output"],
+                         logger=logger)
+        self._name = name
+        assert len(self._data_names) == 1
+        assert len(self._label_names) == 1
+        self._scores = None
+        self._labels = None
+        self._scores_grad = None
+        if grad_func is not None:
+            assert callable(grad_func)
+        self._grad_func = grad_func
+
+    def _compute_output_shapes(self):
+        return [(self._name + "_output", tuple(self._data_shapes[0][1])
+                 if isinstance(self._data_shapes[0], tuple)
+                 and len(self._data_shapes[0]) == 2
+                 else tuple(self._data_shapes[0]))]
+
+    def forward(self, data_batch, is_train=None):
+        self._scores = data_batch.data[0]
+        if is_train is None:
+            is_train = self.for_training
+        if is_train:
+            self._labels = data_batch.label[0]
+
+    def get_outputs(self, merge_multi_context=True):
+        return [self._scores]
+
+    def backward(self, out_grads=None):
+        assert out_grads is None, \
+            "PythonLossModule is a loss; it accepts no out_grads"
+        if self._grad_func is not None:
+            from ..ndarray import array as nd_array
+            grad = self._grad_func(self._scores, self._labels)
+            if isinstance(grad, np.ndarray):
+                grad = nd_array(grad)
+            self._scores_grad = grad
+        else:
+            raise NotImplementedError(
+                "PythonLossModule requires grad_func (the reference's "
+                "autograd fallback path is subsumed by mx.autograd)")
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._scores_grad]
+
+    def install_monitor(self, mon):
+        pass

@@ -1,0 +1,153 @@
+"""SequentialModule: chain modules end to end
+(reference: python/mxnet/module/sequential_module.py:28)."""
+from __future__ import annotations
+
+import logging
+
+from .base_module import BaseModule
+
+__all__ = ["SequentialModule"]
+
+
+class SequentialModule(BaseModule):
+    """Container chaining multiple modules: outputs of module i feed the
+    data of module i+1 (reference: sequential_module.py:28-60)."""
+
+    META_TAKE_LABELS = "take_labels"
+    META_AUTO_WIRING = "auto_wiring"
+
+    def __init__(self, logger=logging):
+        super().__init__(logger=logger)
+        self._modules = []
+        self._metas = []
+        self._label_shapes = None
+        self._data_shapes = None
+        self.binded = False
+        self.params_initialized = False
+
+    def add(self, module, **kwargs):
+        """Add a module; meta flags: take_labels (this module consumes the
+        loop's labels), auto_wiring (rename data to the previous module's
+        outputs) (reference: sequential_module.py:52)."""
+        self._modules.append(module)
+        self._metas.append(kwargs)
+        self.binded = False
+        self.params_initialized = False
+        return self
+
+    @property
+    def data_names(self):
+        return self._modules[0].data_names if self._modules else []
+
+    @property
+    def output_names(self):
+        return self._modules[-1].output_names if self._modules else []
+
+    @property
+    def data_shapes(self):
+        return self._modules[0].data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._modules[-1].output_shapes
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        assert shared_module is None, \
+            "shared_module is not supported for SequentialModule"
+        self._label_shapes = label_shapes
+        my_data_shapes = data_shapes
+        for i, (module, meta) in enumerate(zip(self._modules, self._metas)):
+            take_labels = meta.get(self.META_TAKE_LABELS, False)
+            module.bind(my_data_shapes,
+                        label_shapes if take_labels else None,
+                        for_training=for_training,
+                        inputs_need_grad=inputs_need_grad or i > 0,
+                        force_rebind=force_rebind, grad_req=grad_req)
+            # wire: next module's data shapes = this module's output shapes
+            my_data_shapes = list(module.output_shapes)
+            if i + 1 < len(self._modules) and \
+                    self._metas[i + 1].get(self.META_AUTO_WIRING, False):
+                nxt = self._modules[i + 1].data_names
+                my_data_shapes = [(n, s) for n, (_, s) in
+                                  zip(nxt, my_data_shapes)]
+        self.binded = True
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False,
+                    allow_extra=False):
+        assert self.binded
+        for module in self._modules:
+            module.init_params(initializer=initializer,
+                               arg_params=arg_params, aux_params=aux_params,
+                               allow_missing=True, force_init=force_init,
+                               allow_extra=True)
+        self.params_initialized = True
+
+    def get_params(self):
+        arg_params, aux_params = {}, {}
+        for module in self._modules:
+            a, x = module.get_params()
+            arg_params.update(a)
+            aux_params.update(x)
+        return arg_params, aux_params
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        for module in self._modules:
+            module.set_params(arg_params, aux_params, allow_missing=True,
+                              force_init=force_init, allow_extra=True)
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        for module in self._modules:
+            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                  optimizer_params=optimizer_params,
+                                  force_init=force_init)
+
+    def forward(self, data_batch, is_train=None):
+        from ..io import DataBatch
+        batch = data_batch
+        for i, module in enumerate(self._modules):
+            module.forward(batch, is_train=is_train)
+            if i + 1 == len(self._modules):
+                break
+            # labels always travel with the chain so any downstream module
+            # marked take_labels can consume them (reference behavior)
+            batch = DataBatch(module.get_outputs(), data_batch.label,
+                              pad=getattr(data_batch, "pad", 0))
+
+    def backward(self, out_grads=None):
+        grads = out_grads
+        for i in range(len(self._modules) - 1, -1, -1):
+            self._modules[i].backward(out_grads=grads)
+            if i > 0:
+                grads = self._modules[i].get_input_grads()
+
+    def update(self):
+        for module in self._modules:
+            module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._modules[-1].get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._modules[0].get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        # only modules that declared take_labels score; a pure feature
+        # chain is a no-op (reference: sequential_module.py update_metric)
+        for module, meta in zip(self._modules, self._metas):
+            if meta.get(self.META_TAKE_LABELS, False):
+                module.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        for module in self._modules:
+            module.install_monitor(mon)

@@ -125,3 +125,71 @@ def test_bucketing_module():
         mod.backward()
         mod.update()
     assert mod.get_outputs()[0].shape == (4, 4)
+
+
+class TestSequentialModule:
+    def test_chain_trains(self):
+        # module 1: features; module 2: classifier consuming labels
+        # (reference: sequential_module.py usage in test_module.py)
+        net1 = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                     name="fc1")
+        net1 = mx.sym.Activation(net1, act_type="relu", name="relu1")
+        net2 = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                                     name="fc2")
+        net2 = mx.sym.SoftmaxOutput(net2, name="softmax")
+        seq = mx.mod.SequentialModule()
+        seq.add(mx.mod.Module(net1, label_names=[])) \
+           .add(mx.mod.Module(net2), take_labels=True, auto_wiring=True)
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 8).astype(np.float32)
+        w = rng.randn(3, 8).astype(np.float32)
+        y = (x @ w.T).argmax(1).astype(np.float32)
+
+        seq.bind(data_shapes=[("data", (8, 8))],
+                 label_shapes=[("softmax_label", (8,))])
+        seq.init_params(initializer=mx.init.Xavier())
+        seq.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        metric = mx.metric.Accuracy()
+        batch = None
+        from mxnet_tpu.io import DataBatch
+        for epoch in range(30):
+            metric.reset()
+            for lo in range(0, 32, 8):
+                batch = DataBatch([mx.nd.array(x[lo:lo + 8])],
+                                  [mx.nd.array(y[lo:lo + 8])])
+                seq.forward(batch, is_train=True)
+                seq.backward()
+                seq.update()
+                seq.update_metric(metric, batch.label)
+        assert metric.get()[1] > 0.8, metric.get()
+
+    def test_get_params_merges(self):
+        net1 = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                     name="a")
+        net2 = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                                     name="b")
+        seq = mx.mod.SequentialModule()
+        seq.add(mx.mod.Module(net1, label_names=[])) \
+           .add(mx.mod.Module(net2, label_names=[]), auto_wiring=True)
+        seq.bind(data_shapes=[("data", (1, 6))])
+        seq.init_params(initializer=mx.init.Xavier())
+        args, _ = seq.get_params()
+        assert "a_weight" in args and "b_weight" in args
+
+
+class TestPythonLossModule:
+    def test_grad_func_loss(self):
+        from mxnet_tpu.io import DataBatch
+        mod = mx.mod.PythonLossModule(
+            grad_func=lambda scores, labels:
+                scores.asnumpy() - labels.asnumpy())
+        mod.bind(data_shapes=[("data", (4, 3))],
+                 label_shapes=[("softmax_label", (4, 3))], for_training=True)
+        scores = mx.nd.array(np.ones((4, 3), np.float32))
+        labels = mx.nd.array(np.zeros((4, 3), np.float32))
+        mod.forward(DataBatch([scores], [labels]), is_train=True)
+        assert mod.get_outputs()[0] is scores
+        mod.backward()
+        np.testing.assert_allclose(mod.get_input_grads()[0].asnumpy(), 1.0)

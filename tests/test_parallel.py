@@ -154,3 +154,18 @@ def test_tp_weights_actually_sharded():
                 assert leaf.sharding.spec == v.sharding.spec
             elif hasattr(leaf, "sharding"):
                 assert leaf.sharding.spec == P()
+
+
+def test_model_parallel_lstm_example_converges():
+    """Model-parallel LSTM example (reference:
+    example/model-parallel/lstm) — loss must drop steeply on the
+    data x model mesh."""
+    import importlib.util
+    import pathlib
+    path = (pathlib.Path(__file__).parent.parent / "examples"
+            / "model_parallel_lstm" / "train.py")
+    spec = importlib.util.spec_from_file_location("mp_lstm", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    losses = mod.train(num_epoch=3, log=lambda *a: None)
+    assert losses[-1] < losses[0] * 0.5, losses
