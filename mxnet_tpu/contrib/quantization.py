@@ -179,21 +179,49 @@ def _int8_dense(x, qw, w_scale, bias, act_thresh):
     return out
 
 
-def _int8_conv(x, qw, w_scale, bias, act_thresh, strides, padding):
+def _int8_conv(x, qw, w_scale, bias, act_thresh, strides, padding,
+               dilation=(1, 1), groups=1):
     """Quantized Conv2D (NCHW/OIHW) with int32 accumulation."""
     import jax
     import jax.numpy as jnp
     x_scale = act_thresh / 127.0
     qx = jnp.clip(jnp.round(x / x_scale), -127, 127).astype(jnp.int8)
     acc = jax.lax.conv_general_dilated(
-        qx.astype(jnp.int8), qw, window_strides=strides,
+        qx, qw, window_strides=tuple(strides),
         padding=[(p, p) for p in padding],
+        rhs_dilation=tuple(dilation),
+        feature_group_count=int(groups),
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         preferred_element_type=jnp.int32)
     out = acc.astype(jnp.float32) * (x_scale * w_scale.reshape(1, -1, 1, 1))
     if bias is not None:
         out = out + bias.reshape(1, -1, 1, 1)
     return out
+
+
+class _QuantizedConv2D:
+    def __init__(self, layer, thresh):
+        w = layer.weight.data()._data
+        self.qw, self.w_scale = _quantize_per_channel(w, axis=0)
+        self.w_scale = self.w_scale.reshape(-1)
+        self.bias = layer.bias.data()._data if layer.bias is not None else None
+        self.thresh = thresh
+        self._layer = layer
+        kw = layer._kwargs
+        self.strides = kw["stride"]
+        self.padding = kw["pad"]
+        self.dilation = kw["dilate"]
+        self.groups = kw["num_group"]
+
+    def __call__(self, x):
+        out = _int8_conv(x, self.qw, self.w_scale, self.bias, self.thresh,
+                         self.strides, self.padding, self.dilation,
+                         self.groups)
+        act = getattr(self._layer, "act", None)
+        if act is not None:
+            from ..ndarray.ndarray import _wrap
+            out = act(_wrap(out))._data
+        return out
 
 
 class _QuantizedDense:
@@ -234,7 +262,8 @@ def quantize_net(net, calib_data, calib_mode="naive",
     # 1. collect per-layer input ranges on the fp32 net
     collector = CalibrationCollector(mode=calib_mode)
     dense_layers = [(name, blk) for name, blk in _walk(net)
-                    if isinstance(blk, nn.Dense) and name not in exclude]
+                    if isinstance(blk, (nn.Dense, nn.Conv2D))
+                    and name not in exclude]
     taps = {}
 
     def make_hook(name, blk):
@@ -262,7 +291,9 @@ def quantize_net(net, calib_data, calib_mode="naive",
     thresholds = collector.thresholds()
 
     # 2. swap in quantized forwards
-    qmap = {name: _QuantizedDense(blk, thresholds.get(name, 1.0))
+    qmap = {name: (_QuantizedConv2D(blk, thresholds.get(name, 1.0))
+                   if isinstance(blk, nn.Conv2D)
+                   else _QuantizedDense(blk, thresholds.get(name, 1.0)))
             for name, blk in dense_layers}
 
     def quantized_forward(x):
