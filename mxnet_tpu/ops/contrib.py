@@ -1,10 +1,14 @@
-"""Contrib operators: CTC loss, SSD MultiBox family, box_nms.
+"""Contrib operators: CTC loss, the SSD MultiBox family, box_nms, FFT,
+Correlation, Crop, RPN Proposal/MultiProposal, count_sketch,
+DeformableConvolution, and the PSROI pooling family.
 
-Reference analogs: src/operator/contrib/ctc_loss.cc, multibox_prior.cc,
-multibox_target.cc, multibox_detection.cc, bounding_box.cc. All are
-re-derived as vectorized jax/lax code (fixed shapes, scan/while-free where
-possible) so XLA can fuse and tile them for TPU; none of the reference's
-kernel code is used.
+Reference analogs: src/operator/contrib/{ctc_loss, multibox_prior,
+multibox_target, multibox_detection, bounding_box, fft, ifft, proposal,
+multi_proposal, count_sketch, deformable_convolution,
+psroi_pooling, deformable_psroi_pooling}.cc and src/operator/
+{correlation, crop}.cc. All are re-derived as vectorized jax/lax code
+(fixed shapes, scan/while-free where possible) so XLA can fuse and tile
+them for TPU; none of the reference's kernel code is used.
 """
 from __future__ import annotations
 
@@ -774,3 +778,160 @@ def deformable_convolution(data, offset, weight, bias=None, kernel=None,
     if bias is not None and not no_bias:
         out = out + bias.reshape(1, -1, 1, 1)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Position-sensitive ROI pooling (R-FCN family; reference:
+# src/operator/contrib/psroi_pooling.cu:51-120,
+# deformable_psroi_pooling.cu:71-161)
+# ---------------------------------------------------------------------------
+def _psroi_one(data, roi, spatial_scale, output_dim, group_size, pooled):
+    """One ROI over one batch of feature maps: data (B, C, H, W),
+    roi [batch_ind, x1, y1, x2, y2]. Integer-grid average pooling of the
+    position-sensitive channel (psroi_pooling.cu:51)."""
+    B, C, H, W = data.shape
+    G = group_size
+    img = data[roi[0].astype(jnp.int32)]
+    ps = img.reshape(output_dim, G, G, H, W)
+    start_w = jnp.round(roi[1]) * spatial_scale
+    start_h = jnp.round(roi[2]) * spatial_scale
+    end_w = (jnp.round(roi[3]) + 1.0) * spatial_scale
+    end_h = (jnp.round(roi[4]) + 1.0) * spatial_scale
+    roi_w = jnp.maximum(end_w - start_w, 0.1)
+    roi_h = jnp.maximum(end_h - start_h, 0.1)
+    bin_h = roi_h / pooled
+    bin_w = roi_w / pooled
+    hs = jnp.arange(H, dtype=jnp.float32)
+    ws = jnp.arange(W, dtype=jnp.float32)
+    out = []
+    for ph in range(pooled):
+        row = []
+        for pw in range(pooled):
+            hstart = jnp.clip(jnp.floor(ph * bin_h + start_h), 0, H)
+            hend = jnp.clip(jnp.ceil((ph + 1) * bin_h + start_h), 0, H)
+            wstart = jnp.clip(jnp.floor(pw * bin_w + start_w), 0, W)
+            wend = jnp.clip(jnp.ceil((pw + 1) * bin_w + start_w), 0, W)
+            mask = ((hs[:, None] >= hstart) & (hs[:, None] < hend)
+                    & (ws[None, :] >= wstart) & (ws[None, :] < wend))
+            gh = min(max(int(ph * G // pooled), 0), G - 1)
+            gw = min(max(int(pw * G // pooled), 0), G - 1)
+            sel = ps[:, gh, gw]                       # (output_dim, H, W)
+            total = jnp.sum(sel * mask, axis=(1, 2))
+            area = jnp.maximum(mask.sum(), 1)
+            empty = (hend <= hstart) | (wend <= wstart)
+            row.append(jnp.where(empty, 0.0, total / area))
+        out.append(jnp.stack(row, axis=-1))
+    return jnp.stack(out, axis=-2)                    # (output_dim, p, p)
+
+
+@register_op("PSROIPooling", aliases=["_contrib_PSROIPooling"])
+def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=None,
+                  pooled_size=None, group_size=0, **kw):
+    """Position-sensitive ROI pooling (reference: psroi_pooling.cu:51).
+    data: (B, output_dim*G*G, H, W); rois: (R, 5). Output
+    (R, output_dim, pooled, pooled)."""
+    group_size = int(group_size) or int(pooled_size)
+    fn = lambda r: _psroi_one(data, r, float(spatial_scale),
+                              int(output_dim), group_size,
+                              int(pooled_size))
+    return jax.vmap(fn)(rois)
+
+
+def _dpsroi_one(data, roi, trans, spatial_scale, output_dim, group_size,
+                pooled, part_size, sample_per_part, trans_std, num_classes):
+    """Deformable PSROI pooling for one ROI
+    (reference: deformable_psroi_pooling.cu:71-161)."""
+    B, C, H, W = data.shape
+    G = group_size
+    img = data[roi[0].astype(jnp.int32)]
+    ps = img.reshape(output_dim, G, G, H, W)
+    start_w = jnp.round(roi[1]) * spatial_scale - 0.5
+    start_h = jnp.round(roi[2]) * spatial_scale - 0.5
+    end_w = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+    end_h = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+    roi_w = jnp.maximum(end_w - start_w, 0.1)
+    roi_h = jnp.maximum(end_h - start_h, 0.1)
+    bin_h = roi_h / pooled
+    bin_w = roi_w / pooled
+    sub_h = bin_h / sample_per_part
+    sub_w = bin_w / sample_per_part
+
+    cls_per = output_dim // num_classes
+    out = jnp.zeros((output_dim, pooled, pooled))
+    for ph in range(pooled):
+        for pw in range(pooled):
+            part_h = int(ph * part_size // pooled)
+            part_w = int(pw * part_size // pooled)
+            if trans is None:
+                tx = ty = 0.0
+            else:
+                # trans (num_classes*2, part, part); class of ctop
+                cls = jnp.arange(output_dim) // cls_per
+                tx = trans[cls * 2, part_h, part_w] * trans_std
+                ty = trans[cls * 2 + 1, part_h, part_w] * trans_std
+            hstart = ph * bin_h + start_h + ty * roi_h
+            wstart = pw * bin_w + start_w + tx * roi_w
+            gh = min(max(int(ph * G // pooled), 0), G - 1)
+            gw = min(max(int(pw * G // pooled), 0), G - 1)
+            sel = ps[:, gh, gw]                      # (output_dim, H, W)
+            acc = 0.0
+            cnt = 0.0
+            for ih in range(sample_per_part):
+                for iw in range(sample_per_part):
+                    h = hstart + ih * sub_h
+                    w = wstart + iw * sub_w
+                    ok = (w >= -0.5) & (w <= W - 0.5) \
+                        & (h >= -0.5) & (h <= H - 0.5)
+                    hc = jnp.clip(h, 0.0, H - 1.0)
+                    wc = jnp.clip(w, 0.0, W - 1.0)
+                    h0 = jnp.floor(hc)
+                    w0 = jnp.floor(wc)
+                    dh = hc - h0
+                    dw = wc - w0
+                    h0i = h0.astype(jnp.int32)
+                    w0i = w0.astype(jnp.int32)
+                    h1i = jnp.minimum(h0i + 1, H - 1)
+                    w1i = jnp.minimum(w0i + 1, W - 1)
+                    if trans is None:
+                        v = (sel[:, h0i, w0i] * (1 - dh) * (1 - dw)
+                             + sel[:, h0i, w1i] * (1 - dh) * dw
+                             + sel[:, h1i, w0i] * dh * (1 - dw)
+                             + sel[:, h1i, w1i] * dh * dw)
+                        acc = acc + jnp.where(ok, v, 0.0)
+                        cnt = cnt + jnp.where(ok, 1.0, 0.0)
+                    else:
+                        d = jnp.arange(output_dim)
+                        v = (sel[d, h0i, w0i] * (1 - dh) * (1 - dw)
+                             + sel[d, h0i, w1i] * (1 - dh) * dw
+                             + sel[d, h1i, w0i] * dh * (1 - dw)
+                             + sel[d, h1i, w1i] * dh * dw)
+                        acc = acc + jnp.where(ok, v, 0.0)
+                        cnt = cnt + jnp.where(ok, 1.0, 0.0)
+            val = jnp.where(cnt > 0, acc / jnp.maximum(cnt, 1.0), 0.0)
+            out = out.at[:, ph, pw].set(val)
+    return out
+
+
+@register_op("DeformablePSROIPooling",
+             aliases=["_contrib_DeformablePSROIPooling"])
+def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                             output_dim=None, group_size=None,
+                             pooled_size=None, part_size=0,
+                             sample_per_part=4, trans_std=0.0,
+                             no_trans=False, **kw):
+    """Deformable position-sensitive ROI pooling (R-FCN / DCN v1;
+    reference: deformable_psroi_pooling.cu:71). trans: (R,
+    num_classes*2, part, part) normalized bin offsets."""
+    part_size = int(part_size) or int(pooled_size)
+    if no_trans:
+        trans = None
+    num_classes = 1
+    if trans is not None:
+        num_classes = trans.shape[1] // 2
+    fn = lambda r, t: _dpsroi_one(
+        data, r, t, float(spatial_scale), int(output_dim),
+        int(group_size), int(pooled_size), part_size,
+        int(sample_per_part), float(trans_std), num_classes)
+    if trans is None:
+        return jax.vmap(lambda r: fn(r, None))(rois)
+    return jax.vmap(fn)(rois, trans)

@@ -259,3 +259,80 @@ class TestDeformableConvolution:
         loss.backward()
         assert float(np.abs(xo.grad.asnumpy()).sum()) > 0
         assert float(np.abs(xd.grad.asnumpy()).sum()) > 0
+
+
+class TestPSROIPooling:
+    def _ps_data(self, od=2, G=3, H=9, W=9):
+        data = np.zeros((1, od * G * G, H, W), np.float32)
+        for c in range(od * G * G):
+            data[0, c] = c
+        return data
+
+    def test_position_sensitive_channel_selection(self):
+        # full-image ROI with G == pooled: bin (ph, pw) of ctop must read
+        # channel (ctop*G + ph)*G + pw exactly
+        data = self._ps_data()
+        rois = np.array([[0, 0, 0, 8, 8]], np.float32)
+        out = nd.PSROIPooling(nd.array(data), nd.array(rois),
+                              spatial_scale=1.0, output_dim=2,
+                              pooled_size=3, group_size=3).asnumpy()[0]
+        expect = np.array([[[(ct * 3 + ph) * 3 + pw for pw in range(3)]
+                            for ph in range(3)] for ct in range(2)],
+                          np.float32)
+        np.testing.assert_allclose(out, expect)
+
+    def test_spatial_scale_and_subroi(self):
+        rng = np.random.RandomState(0)
+        data = rng.rand(1, 1 * 2 * 2, 8, 8).astype(np.float32)
+        # roi in image coords with scale 0.5 -> feature coords / 2
+        rois = np.array([[0, 2, 2, 9, 9]], np.float32)
+        out = nd.PSROIPooling(nd.array(data), nd.array(rois),
+                              spatial_scale=0.5, output_dim=1,
+                              pooled_size=2, group_size=2).asnumpy()
+        assert out.shape == (1, 1, 2, 2)
+        # bin (0,0): channel 0, rows/cols [1, 3) (start 1, bin 2.0)
+        expect00 = data[0, 0, 1:3, 1:3].mean()
+        np.testing.assert_allclose(out[0, 0, 0, 0], expect00, rtol=1e-5)
+
+    def test_deformable_no_trans_matches_ps_structure(self):
+        data = self._ps_data()
+        rois = np.array([[0, 0, 0, 8, 8]], np.float32)
+        out = nd.DeformablePSROIPooling(
+            nd.array(data), nd.array(rois), no_trans=True,
+            spatial_scale=1.0, output_dim=2, group_size=3, pooled_size=3,
+            sample_per_part=2).asnumpy()[0]
+        expect = np.array([[[(ct * 3 + ph) * 3 + pw for pw in range(3)]
+                            for ph in range(3)] for ct in range(2)],
+                          np.float32)
+        np.testing.assert_allclose(out, expect)
+
+    def test_deformable_trans_shifts_samples(self):
+        # a horizontal gradient image: positive x-offset raises the pooled
+        # value by offset * roi_width
+        H = W = 12
+        data = np.tile(np.arange(W, dtype=np.float32), (1, 1, H, 1))
+        rois = np.array([[0, 2, 2, 9, 9]], np.float32)
+        base = nd.DeformablePSROIPooling(
+            nd.array(data), nd.array(rois), no_trans=True,
+            spatial_scale=1.0, output_dim=1, group_size=1, pooled_size=1,
+            sample_per_part=2).asnumpy()
+        trans = np.zeros((1, 2, 1, 1), np.float32)
+        trans[0, 0, 0, 0] = 0.1            # x offset, trans_std 1.0
+        shifted = nd.DeformablePSROIPooling(
+            nd.array(data), nd.array(rois), nd.array(trans),
+            spatial_scale=1.0, output_dim=1, group_size=1, pooled_size=1,
+            sample_per_part=2, trans_std=1.0).asnumpy()
+        roi_w = (9 + 1) - 2  # 8
+        np.testing.assert_allclose(shifted - base, 0.1 * roi_w, rtol=1e-4)
+
+    def test_gradients_flow(self):
+        rng = np.random.RandomState(1)
+        data = nd.array(rng.rand(1, 8, 6, 6).astype(np.float32))
+        rois = nd.array(np.array([[0, 0, 0, 5, 5]], np.float32))
+        data.attach_grad()
+        with mx.autograd.record():
+            loss = nd.PSROIPooling(data, rois, spatial_scale=1.0,
+                                   output_dim=2, pooled_size=2,
+                                   group_size=2).sum()
+        loss.backward()
+        assert float(np.abs(data.grad.asnumpy()).sum()) > 0
