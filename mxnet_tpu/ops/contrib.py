@@ -793,10 +793,12 @@ def _psroi_one(data, roi, spatial_scale, output_dim, group_size, pooled):
     G = group_size
     img = data[roi[0].astype(jnp.int32)]
     ps = img.reshape(output_dim, G, G, H, W)
-    start_w = jnp.round(roi[1]) * spatial_scale
-    start_h = jnp.round(roi[2]) * spatial_scale
-    end_w = (jnp.round(roi[3]) + 1.0) * spatial_scale
-    end_h = (jnp.round(roi[4]) + 1.0) * spatial_scale
+    # floor(x + 0.5) = C round() for the non-negative ROI coords
+    # (jnp.round is half-to-even and would shift half-integer ROIs)
+    start_w = jnp.floor(roi[1] + 0.5) * spatial_scale
+    start_h = jnp.floor(roi[2] + 0.5) * spatial_scale
+    end_w = (jnp.floor(roi[3] + 0.5) + 1.0) * spatial_scale
+    end_h = (jnp.floor(roi[4] + 0.5) + 1.0) * spatial_scale
     roi_w = jnp.maximum(end_w - start_w, 0.1)
     roi_h = jnp.maximum(end_h - start_h, 0.1)
     bin_h = roi_h / pooled
@@ -839,77 +841,82 @@ def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=None,
 
 def _dpsroi_one(data, roi, trans, spatial_scale, output_dim, group_size,
                 pooled, part_size, sample_per_part, trans_std, num_classes):
-    """Deformable PSROI pooling for one ROI
-    (reference: deformable_psroi_pooling.cu:71-161)."""
+    """Deformable PSROI pooling for one ROI, fully vectorized over
+    (output_dim, pooled, pooled, samples) — the reference unrolls this as
+    a CUDA grid (deformable_psroi_pooling.cu:71-161)."""
     B, C, H, W = data.shape
     G = group_size
+    P = pooled
+    S = sample_per_part
     img = data[roi[0].astype(jnp.int32)]
     ps = img.reshape(output_dim, G, G, H, W)
-    start_w = jnp.round(roi[1]) * spatial_scale - 0.5
-    start_h = jnp.round(roi[2]) * spatial_scale - 0.5
-    end_w = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
-    end_h = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+    start_w = jnp.floor(roi[1] + 0.5) * spatial_scale - 0.5
+    start_h = jnp.floor(roi[2] + 0.5) * spatial_scale - 0.5
+    end_w = (jnp.floor(roi[3] + 0.5) + 1.0) * spatial_scale - 0.5
+    end_h = (jnp.floor(roi[4] + 0.5) + 1.0) * spatial_scale - 0.5
     roi_w = jnp.maximum(end_w - start_w, 0.1)
     roi_h = jnp.maximum(end_h - start_h, 0.1)
-    bin_h = roi_h / pooled
-    bin_w = roi_w / pooled
-    sub_h = bin_h / sample_per_part
-    sub_w = bin_w / sample_per_part
+    bin_h = roi_h / P
+    bin_w = roi_w / P
+    sub_h = bin_h / S
+    sub_w = bin_w / S
 
+    ph = jnp.arange(P)
+    pw = jnp.arange(P)
+    # per-bin trans offsets; class of channel ctop = ctop // cls_per
     cls_per = output_dim // num_classes
-    out = jnp.zeros((output_dim, pooled, pooled))
-    for ph in range(pooled):
-        for pw in range(pooled):
-            part_h = int(ph * part_size // pooled)
-            part_w = int(pw * part_size // pooled)
-            if trans is None:
-                tx = ty = 0.0
-            else:
-                # trans (num_classes*2, part, part); class of ctop
-                cls = jnp.arange(output_dim) // cls_per
-                tx = trans[cls * 2, part_h, part_w] * trans_std
-                ty = trans[cls * 2 + 1, part_h, part_w] * trans_std
-            hstart = ph * bin_h + start_h + ty * roi_h
-            wstart = pw * bin_w + start_w + tx * roi_w
-            gh = min(max(int(ph * G // pooled), 0), G - 1)
-            gw = min(max(int(pw * G // pooled), 0), G - 1)
-            sel = ps[:, gh, gw]                      # (output_dim, H, W)
-            acc = 0.0
-            cnt = 0.0
-            for ih in range(sample_per_part):
-                for iw in range(sample_per_part):
-                    h = hstart + ih * sub_h
-                    w = wstart + iw * sub_w
-                    ok = (w >= -0.5) & (w <= W - 0.5) \
-                        & (h >= -0.5) & (h <= H - 0.5)
-                    hc = jnp.clip(h, 0.0, H - 1.0)
-                    wc = jnp.clip(w, 0.0, W - 1.0)
-                    h0 = jnp.floor(hc)
-                    w0 = jnp.floor(wc)
-                    dh = hc - h0
-                    dw = wc - w0
-                    h0i = h0.astype(jnp.int32)
-                    w0i = w0.astype(jnp.int32)
-                    h1i = jnp.minimum(h0i + 1, H - 1)
-                    w1i = jnp.minimum(w0i + 1, W - 1)
-                    if trans is None:
-                        v = (sel[:, h0i, w0i] * (1 - dh) * (1 - dw)
-                             + sel[:, h0i, w1i] * (1 - dh) * dw
-                             + sel[:, h1i, w0i] * dh * (1 - dw)
-                             + sel[:, h1i, w1i] * dh * dw)
-                        acc = acc + jnp.where(ok, v, 0.0)
-                        cnt = cnt + jnp.where(ok, 1.0, 0.0)
-                    else:
-                        d = jnp.arange(output_dim)
-                        v = (sel[d, h0i, w0i] * (1 - dh) * (1 - dw)
-                             + sel[d, h0i, w1i] * (1 - dh) * dw
-                             + sel[d, h1i, w0i] * dh * (1 - dw)
-                             + sel[d, h1i, w1i] * dh * dw)
-                        acc = acc + jnp.where(ok, v, 0.0)
-                        cnt = cnt + jnp.where(ok, 1.0, 0.0)
-            val = jnp.where(cnt > 0, acc / jnp.maximum(cnt, 1.0), 0.0)
-            out = out.at[:, ph, pw].set(val)
-    return out
+    if trans is None:
+        tx = jnp.zeros((output_dim, P, P))
+        ty = jnp.zeros((output_dim, P, P))
+    else:
+        part_h = (ph * part_size // P)                        # (P,)
+        part_w = (pw * part_size // P)                        # (P,)
+        cls = jnp.arange(output_dim) // cls_per               # (D,)
+        tx = trans[cls[:, None, None] * 2,
+                   part_h[None, :, None], part_w[None, None, :]] * trans_std
+        ty = trans[cls[:, None, None] * 2 + 1,
+                   part_h[None, :, None], part_w[None, None, :]] * trans_std
+
+    # sample positions: (D, P, P, S, S)
+    ih = jnp.arange(S)
+    iw = jnp.arange(S)
+    hpos = (ph[None, :, None, None, None] * bin_h + start_h
+            + ty[:, :, :, None, None] * roi_h
+            + ih[None, None, None, :, None] * sub_h)
+    wpos = (pw[None, None, :, None, None] * bin_w + start_w
+            + tx[:, :, :, None, None] * roi_w
+            + iw[None, None, None, None, :] * sub_w)
+    hpos = jnp.broadcast_to(hpos, (output_dim, P, P, S, S))
+    wpos = jnp.broadcast_to(wpos, (output_dim, P, P, S, S))
+
+    ok = ((wpos >= -0.5) & (wpos <= W - 0.5)
+          & (hpos >= -0.5) & (hpos <= H - 0.5))
+    hc = jnp.clip(hpos, 0.0, H - 1.0)
+    wc = jnp.clip(wpos, 0.0, W - 1.0)
+    h0 = jnp.floor(hc)
+    w0 = jnp.floor(wc)
+    dh = hc - h0
+    dw = wc - w0
+    h0i = h0.astype(jnp.int32)
+    w0i = w0.astype(jnp.int32)
+    h1i = jnp.minimum(h0i + 1, H - 1)
+    w1i = jnp.minimum(w0i + 1, W - 1)
+
+    # position-sensitive channel per bin: sel (D, P, P, H, W)
+    gh = jnp.clip(ph * G // P, 0, G - 1)
+    gw = jnp.clip(pw * G // P, 0, G - 1)
+    sel = ps[:, gh[:, None], gw[None, :]]                     # (D,P,P,H,W)
+
+    d_ix = jnp.arange(output_dim)[:, None, None, None, None]
+    p_ix = jnp.arange(P)[None, :, None, None, None]
+    q_ix = jnp.arange(P)[None, None, :, None, None]
+    v = (sel[d_ix, p_ix, q_ix, h0i, w0i] * (1 - dh) * (1 - dw)
+         + sel[d_ix, p_ix, q_ix, h0i, w1i] * (1 - dh) * dw
+         + sel[d_ix, p_ix, q_ix, h1i, w0i] * dh * (1 - dw)
+         + sel[d_ix, p_ix, q_ix, h1i, w1i] * dh * dw)
+    acc = jnp.sum(jnp.where(ok, v, 0.0), axis=(3, 4))
+    cnt = jnp.sum(ok, axis=(3, 4))
+    return jnp.where(cnt > 0, acc / jnp.maximum(cnt, 1), 0.0)
 
 
 @register_op("DeformablePSROIPooling",
