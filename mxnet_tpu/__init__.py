@@ -56,6 +56,7 @@ from . import config
 from . import engine
 from . import runtime
 from . import kvstore_server
+from . import test_utils
 from . import visualization
 from . import visualization as viz
 from . import contrib
