@@ -1,0 +1,537 @@
+"""Functional optimizer rules for the fused training step.
+
+The eager ``mxnet_tpu.optimizer`` classes mutate NDArrays and keep their
+update count in Python — correct for the per-parameter Updater loop, but
+wrong inside one traced XLA step (the count would be baked in at trace
+time). This module provides the pure counterpart: for every registered
+optimizer name, ``create(name, **kwargs)`` returns a rule with
+
+    init(param)                    -> state tuple (jnp leaves)
+    update(param, grad, state, lr, t, wd, key=None)
+                                   -> (new_param, new_state)
+
+where ``t`` is the TRACED 1-based update count (a device scalar advancing
+inside the compiled step) and ``lr``/``wd`` are per-call values so the
+caller can apply schedules and per-parameter lr_mult/wd_mult. The math
+mirrors ops/optimizer_ops.py (reference: src/operator/optimizer_op.cc)
+and the eager classes in optimizer.py (reference: python/mxnet/optimizer.py).
+
+Used by parallel.step.TrainStep (gluon path) so that ANY ``--optimizer X``
+runs inside the single fused fwd+bwd+update XLA program — no eager
+per-parameter fallback.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["create", "from_optimizer", "supported", "FunctionalOptimizer"]
+
+
+class FunctionalOptimizer:
+    """A pure optimizer rule: closures over static hyperparameters."""
+
+    def __init__(self, name, init_fn, update_fn, needs_key=False):
+        self.name = name
+        self.init = init_fn            # p -> state tuple
+        self._update = update_fn       # (p, g, s, lr, t, wd, key) -> (p, s)
+        self.needs_key = needs_key
+
+    def update(self, p, g, s, lr, t, wd=0.0, key=None):
+        return self._update(p, g, s, lr, t, wd, key)
+
+
+_FACTORIES: Dict[str, Callable] = {}
+
+
+def _factory(*names):
+    def deco(fn):
+        for n in names:
+            _FACTORIES[n] = fn
+        return fn
+    return deco
+
+
+def supported():
+    return sorted(_FACTORIES)
+
+
+# hyperparameter names each rule accepts (plus the common prologue keys);
+# create() rejects anything else so a misspelled optimizer_param fails fast
+# instead of silently training with defaults
+_COMMON_KEYS = {"rescale_grad", "clip_gradient"}
+_PARAM_KEYS = {
+    "sgd": {"momentum", "lazy_update"},
+    "nag": {"momentum"},
+    "lbsgd": {"momentum", "eta", "warmup_strategy", "warmup_epochs",
+              "updates_per_epoch", "batch_scale", "begin_epoch",
+              "num_epochs", "multi_precision"},
+    "lars": {"momentum", "eta", "warmup_strategy", "warmup_epochs",
+             "updates_per_epoch", "batch_scale"},
+    "adam": {"beta1", "beta2", "epsilon", "lazy_update"},
+    "adamax": {"beta1", "beta2"},
+    "nadam": {"beta1", "beta2", "epsilon", "schedule_decay"},
+    "ftml": {"beta1", "beta2", "epsilon"},
+    "adagrad": {"eps"},
+    "rmsprop": {"gamma1", "gamma2", "epsilon", "centered", "clip_weights"},
+    "adadelta": {"rho", "epsilon"},
+    "ftrl": {"lamda1", "beta"},
+    "signsgd": set(),
+    "signum": {"momentum", "wd_lh"},
+    "sgld": set(),
+    "dcasgd": {"momentum", "lamda"},
+    "test": set(),
+}
+
+
+def create(name, **kwargs) -> FunctionalOptimizer:
+    name = name.lower()
+    if name not in _FACTORIES:
+        raise ValueError(
+            f"no functional rule for optimizer '{name}'; supported: "
+            f"{supported()}")
+    unknown = set(kwargs) - _PARAM_KEYS[name] - _COMMON_KEYS
+    if unknown:
+        raise TypeError(
+            f"optimizer '{name}' got unexpected parameters {sorted(unknown)}"
+            f"; accepted: {sorted(_PARAM_KEYS[name] | _COMMON_KEYS)}")
+    return _FACTORIES[name](kwargs)
+
+
+def _g32(g, p, kw):
+    """Common gradient preprocessing: f32, rescale, clip (the reference's
+    KERNEL_ASSIGN prologue in optimizer_op-inl.h)."""
+    g = g.astype(jnp.float32) * kw.get("rescale_grad", 1.0)
+    clip = kw.get("clip_gradient")
+    if clip is not None and clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    return g
+
+
+def _g32_wd_then_clip(g, p, kw, wd):
+    """Variant where weight decay is folded in BEFORE clipping — the
+    adamax/nadam/ftml ordering in the eager classes (optimizer.py:609,
+    640; ftml_update optimizer_ops.py:122)."""
+    g = g.astype(jnp.float32) * kw.get("rescale_grad", 1.0) + wd * p
+    clip = kw.get("clip_gradient")
+    if clip is not None and clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    return g
+
+
+def _zeros(p):
+    return jnp.zeros_like(p, dtype=jnp.float32)
+
+
+# -- sgd / nag / lbsgd --------------------------------------------------------
+
+@_factory("sgd")
+def _make_sgd(kw):
+    momentum = kw.get("momentum", 0.0)
+
+    def init(p):
+        return (_zeros(p),) if momentum else ()
+
+    def update(p, g, s, lr, t, wd, key):
+        g = _g32(g, p, kw) + wd * p
+        if momentum:
+            (mom,) = s
+            mom = momentum * mom - lr * g
+            return p + mom, (mom,)
+        return p - lr * g, ()
+
+    return FunctionalOptimizer("sgd", init, update)
+
+
+@_factory("nag")
+def _make_nag(kw):
+    momentum = kw.get("momentum", 0.0)
+
+    def init(p):
+        return (_zeros(p),)
+
+    def update(p, g, s, lr, t, wd, key):
+        g = _g32(g, p, kw) + wd * p
+        (mom,) = s
+        mom = momentum * mom + g
+        return p - lr * (g + momentum * mom), (mom,)
+
+    return FunctionalOptimizer("nag", init, update)
+
+
+@_factory("lbsgd")
+def _make_lbsgd(kw):
+    """Large-batch SGD (reference: optimizer.py LBSGD). Defaults mirror the
+    eager class: warmup_strategy='linear', raw trust ratio (no eta factor)
+    for strategy='lars'. The 'lars' alias below keeps TrainStep's historic
+    eta-scaled semantics. Scheduled strategies use the traced count."""
+    momentum = kw.get("momentum", 0.9)
+    eta = kw.get("eta", 1.0)
+    strategy = kw.get("warmup_strategy", "linear")
+    warmup_epochs = kw.get("warmup_epochs", 5)
+    updates_per_epoch = kw.get("updates_per_epoch", 32)
+    batch_scale = float(kw.get("batch_scale", 1))
+
+    def init(p):
+        return (_zeros(p),)
+
+    def update(p, g, s, lr, t, wd, key):
+        g = _g32(g, p, kw)
+        if strategy == "lars":
+            w_norm = jnp.linalg.norm(p.ravel())
+            g_norm = jnp.linalg.norm(g.ravel())
+            mult = jnp.where((w_norm > 0) & (g_norm > 0),
+                             eta * w_norm / (g_norm + wd * w_norm + 1e-9),
+                             1.0)
+        else:
+            nwup = float(warmup_epochs * updates_per_epoch)
+            nup = t.astype(jnp.float32)
+            if nwup <= 1:
+                mult = batch_scale
+            elif strategy == "linear":
+                mult = 1.0 + (batch_scale - 1) * nup / nwup
+            elif strategy == "power2":
+                mult = 1.0 + (batch_scale - 1) * (nup * nup) / (nwup * nwup)
+            elif strategy == "sqrt":
+                mult = 1.0 + (batch_scale - 1) * jnp.sqrt(nup / nwup)
+            else:
+                mult = 1.0
+            mult = jnp.minimum(mult, batch_scale)
+        lr = lr * mult
+        (mom,) = s
+        mom = momentum * mom + lr * (g + wd * p)
+        return p - mom, (mom,)
+
+    return FunctionalOptimizer("lbsgd", init, update)
+
+
+@_factory("lars")
+def _make_lars(kw):
+    """TrainStep's 'lars' name: LBSGD with trust-ratio warmup and the
+    conventional eta=0.001 LARS coefficient (You et al.; the eager LBSGD
+    folds eta into the base lr instead)."""
+    kw = dict(kw)
+    kw.setdefault("warmup_strategy", "lars")
+    kw.setdefault("eta", 0.001)
+    return _make_lbsgd(kw)
+
+
+# -- adam family --------------------------------------------------------------
+
+@_factory("adam")
+def _make_adam(kw):
+    beta1 = kw.get("beta1", 0.9)
+    beta2 = kw.get("beta2", 0.999)
+    epsilon = kw.get("epsilon", 1e-8)
+
+    def init(p):
+        return (_zeros(p), _zeros(p))
+
+    def update(p, g, s, lr, t, wd, key):
+        g = _g32(g, p, kw) + wd * p
+        mean, var = s
+        mean = beta1 * mean + (1 - beta1) * g
+        var = beta2 * var + (1 - beta2) * jnp.square(g)
+        tf = t.astype(jnp.float32)
+        lr_t = lr * jnp.sqrt(1 - beta2 ** tf) / (1 - beta1 ** tf)
+        return p - lr_t * mean / (jnp.sqrt(var) + epsilon), (mean, var)
+
+    return FunctionalOptimizer("adam", init, update)
+
+
+@_factory("adamax")
+def _make_adamax(kw):
+    beta1 = kw.get("beta1", 0.9)
+    beta2 = kw.get("beta2", 0.999)
+
+    def init(p):
+        return (_zeros(p), _zeros(p))
+
+    def update(p, g, s, lr, t, wd, key):
+        g = _g32_wd_then_clip(g, p, kw, wd)
+        m, u = s
+        m = beta1 * m + (1 - beta1) * g
+        u = jnp.maximum(beta2 * u, jnp.abs(g))
+        lr_t = lr / (1 - beta1 ** t.astype(jnp.float32))
+        return p - lr_t * m / (u + 1e-8), (m, u)
+
+    return FunctionalOptimizer("adamax", init, update)
+
+
+@_factory("nadam")
+def _make_nadam(kw):
+    beta1 = kw.get("beta1", 0.9)
+    beta2 = kw.get("beta2", 0.999)
+    epsilon = kw.get("epsilon", 1e-8)
+    decay = kw.get("schedule_decay", 0.004)
+
+    def init(p):
+        # m_schedule is carried as state — the eager class accumulates it
+        # in Python (optimizer.py Nadam.m_schedule), which cannot live
+        # across traced steps
+        return (_zeros(p), _zeros(p), jnp.ones((), jnp.float32))
+
+    def update(p, g, s, lr, t, wd, key):
+        g = _g32_wd_then_clip(g, p, kw, wd)
+        m, v, m_sched = s
+        tf = t.astype(jnp.float32)
+        mom_t = beta1 * (1.0 - 0.5 * 0.96 ** (tf * decay))
+        mom_t1 = beta1 * (1.0 - 0.5 * 0.96 ** ((tf + 1) * decay))
+        m_sched = m_sched * mom_t
+        m_sched_next = m_sched * mom_t1
+        m = beta1 * m + (1 - beta1) * g
+        v = beta2 * v + (1 - beta2) * jnp.square(g)
+        g_prime = g / (1 - m_sched)
+        m_prime = m / (1 - m_sched_next)
+        v_prime = v / (1 - beta2 ** tf)
+        m_bar = (1 - mom_t) * g_prime + mom_t1 * m_prime
+        return p - lr * m_bar / (jnp.sqrt(v_prime) + epsilon), \
+            (m, v, m_sched)
+
+    return FunctionalOptimizer("nadam", init, update)
+
+
+@_factory("ftml")
+def _make_ftml(kw):
+    beta1 = kw.get("beta1", 0.6)
+    beta2 = kw.get("beta2", 0.999)
+    epsilon = kw.get("epsilon", 1e-8)
+
+    def init(p):
+        return (_zeros(p), _zeros(p), _zeros(p))
+
+    def update(p, g, s, lr, t, wd, key):
+        g = _g32_wd_then_clip(g, p, kw, wd)
+        d, v, z = s
+        tf = t.astype(jnp.float32)
+        v = beta2 * v + (1 - beta2) * jnp.square(g)
+        d_new = (1 - beta1 ** tf) / lr * (
+            jnp.sqrt(v / (1 - beta2 ** tf)) + epsilon)
+        sigma = d_new - beta1 * d
+        z = beta1 * z + (1 - beta1) * g - sigma * p
+        return -z / d_new, (d_new, v, z)
+
+    return FunctionalOptimizer("ftml", init, update)
+
+
+# -- adaptive-rate family -----------------------------------------------------
+
+@_factory("adagrad")
+def _make_adagrad(kw):
+    eps = kw.get("eps", 1e-7)
+
+    def init(p):
+        return (_zeros(p),)
+
+    def update(p, g, s, lr, t, wd, key):
+        g = _g32(g, p, kw)
+        (h,) = s
+        h = h + jnp.square(g)
+        return p - lr * (g / jnp.sqrt(h + eps) + wd * p), (h,)
+
+    return FunctionalOptimizer("adagrad", init, update)
+
+
+@_factory("rmsprop")
+def _make_rmsprop(kw):
+    gamma1 = kw.get("gamma1", 0.9)
+    gamma2 = kw.get("gamma2", 0.9)
+    epsilon = kw.get("epsilon", 1e-8)
+    centered = kw.get("centered", False)
+    clip_weights = kw.get("clip_weights")
+
+    def init(p):
+        return (_zeros(p), _zeros(p), _zeros(p)) if centered else (_zeros(p),)
+
+    def update(p, g, s, lr, t, wd, key):
+        g = _g32(g, p, kw) + wd * p
+        if not centered:
+            (n,) = s
+            n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+            w = p - lr * g / jnp.sqrt(n + epsilon)
+            st = (n,)
+        else:
+            n, gbar, delta = s
+            n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+            gbar = gamma1 * gbar + (1 - gamma1) * g
+            delta = gamma2 * delta - lr * g / jnp.sqrt(
+                n - jnp.square(gbar) + epsilon)
+            w = p + delta
+            st = (n, gbar, delta)
+        if clip_weights is not None and clip_weights > 0:
+            w = jnp.clip(w, -clip_weights, clip_weights)
+        return w, st
+
+    return FunctionalOptimizer("rmsprop", init, update)
+
+
+@_factory("adadelta")
+def _make_adadelta(kw):
+    rho = kw.get("rho", 0.90)
+    epsilon = kw.get("epsilon", 1e-5)
+
+    def init(p):
+        return (_zeros(p), _zeros(p))
+
+    def update(p, g, s, lr, t, wd, key):
+        g = _g32(g, p, kw)
+        acc_g, acc_d = s
+        acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+        cur = jnp.sqrt(acc_d + epsilon) / jnp.sqrt(acc_g + epsilon) * g
+        acc_d = rho * acc_d + (1 - rho) * jnp.square(cur)
+        return p - cur - wd * p, (acc_g, acc_d)
+
+    return FunctionalOptimizer("adadelta", init, update)
+
+
+@_factory("ftrl")
+def _make_ftrl(kw):
+    lamda1 = kw.get("lamda1", 0.01)
+    beta = kw.get("beta", 1.0)
+
+    def init(p):
+        return (_zeros(p), _zeros(p))
+
+    def update(p, g, s, lr, t, wd, key):
+        g = _g32(g, p, kw)
+        z, n = s
+        n_new = n + jnp.square(g)
+        sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+        z = z + g - sigma * p
+        w = jnp.where(
+            jnp.abs(z) <= lamda1, jnp.zeros_like(p),
+            (jnp.sign(z) * lamda1 - z) / ((beta + jnp.sqrt(n_new)) / lr + wd))
+        return w, (z, n_new)
+
+    return FunctionalOptimizer("ftrl", init, update)
+
+
+# -- sign / noise / delay-compensated family ----------------------------------
+
+@_factory("signsgd")
+def _make_signsgd(kw):
+    def init(p):
+        return ()
+
+    def update(p, g, s, lr, t, wd, key):
+        g = _g32(g, p, kw)
+        return p - lr * (jnp.sign(g) + wd * p), ()
+
+    return FunctionalOptimizer("signsgd", init, update)
+
+
+@_factory("signum")
+def _make_signum(kw):
+    momentum = kw.get("momentum", 0.9)
+    wd_lh = kw.get("wd_lh", 0.0)
+
+    def init(p):
+        return (_zeros(p),) if momentum != 0.0 else ()
+
+    def update(p, g, s, lr, t, wd, key):
+        g = _g32(g, p, kw)
+        if momentum == 0.0:
+            # the eager class dispatches to signsgd_update here
+            # (optimizer.py Signum.update state=None branch)
+            return p - lr * (jnp.sign(g) + wd * p), ()
+        (mom,) = s
+        mom = momentum * mom - (1 - momentum) * (g + wd * p)
+        return (1 - lr * wd_lh) * p + lr * jnp.sign(mom), (mom,)
+
+    return FunctionalOptimizer("signum", init, update)
+
+
+@_factory("sgld")
+def _make_sgld(kw):
+    def init(p):
+        return ()
+
+    def update(p, g, s, lr, t, wd, key):
+        g = _g32(g, p, kw)
+        noise = jax.random.normal(key, p.shape, jnp.float32) * jnp.sqrt(lr)
+        return p - lr / 2 * (g + wd * p) + noise, ()
+
+    return FunctionalOptimizer("sgld", init, update, needs_key=True)
+
+
+@_factory("dcasgd")
+def _make_dcasgd(kw):
+    """Delay-compensated async SGD (reference: optimizer.py DCASGD). In the
+    fused synchronous step the delay is zero, but the variance-control term
+    is kept for numeric parity with the eager class."""
+    momentum = kw.get("momentum", 0.0)
+    lamda = kw.get("lamda", 0.04)
+
+    def init(p):
+        return (_zeros(p), jnp.array(p, dtype=jnp.float32))
+
+    def update(p, g, s, lr, t, wd, key):
+        g = _g32(g, p, kw)
+        mom, prev_w = s
+        mon = g + wd * p + lamda * g * g * (p - prev_w)
+        mom = momentum * mom - lr * mon
+        # previous_weight tracks the PRE-update weight (optimizer.py:360)
+        return p + mom, (mom, p.astype(jnp.float32))
+
+    return FunctionalOptimizer("dcasgd", init, update)
+
+
+@_factory("test")
+def _make_test(kw):
+    def init(p):
+        return (_zeros(p),)
+
+    def update(p, g, s, lr, t, wd, key):
+        w = p - _g32(g, p, kw)
+        return w, (w,)
+
+    return FunctionalOptimizer("test", init, update)
+
+
+# -- bridging from eager Optimizer objects ------------------------------------
+
+# attrs each eager class carries, keyed by its registered (lowercase) name;
+# every entry also pulls rescale_grad/clip_gradient from the base class
+_ATTR_MAP = {
+    "sgd": ("momentum",),
+    "nag": ("momentum",),
+    "lbsgd": ("momentum", "warmup_strategy", "warmup_epochs",
+              "updates_per_epoch", "batch_scale"),
+    "adam": ("beta1", "beta2", "epsilon"),
+    "adamax": ("beta1", "beta2"),
+    "nadam": ("beta1", "beta2", "epsilon", "schedule_decay"),
+    "ftml": ("beta1", "beta2", "epsilon"),
+    "adagrad": (),
+    "rmsprop": ("gamma1", "gamma2", "epsilon", "centered", "clip_weights"),
+    "adadelta": ("rho", "epsilon"),
+    "ftrl": ("lamda1", "beta"),
+    "signsgd": (),
+    "signum": ("momentum", "wd_lh"),
+    "sgld": (),
+    "dcasgd": ("momentum", "lamda"),
+    "test": (),
+}
+
+
+def from_optimizer(opt) -> FunctionalOptimizer:
+    """Build a functional rule mirroring an eager Optimizer instance.
+
+    Hyperparameters are read off the instance; lr/wd stay per-call so the
+    caller applies opt's schedule and lr_mult/wd_mult itself.
+    """
+    name = type(opt).__name__.lower()
+    if name not in _ATTR_MAP:
+        raise ValueError(
+            f"no functional rule for optimizer class {type(opt).__name__}; "
+            f"supported: {supported()}")
+    kw = {}
+    for a in _ATTR_MAP[name]:
+        if hasattr(opt, a):
+            kw[a] = getattr(opt, a)
+    if name == "adagrad":
+        kw["eps"] = getattr(opt, "float_stable_eps", 1e-7)
+    kw["rescale_grad"] = getattr(opt, "rescale_grad", 1.0)
+    kw["clip_gradient"] = getattr(opt, "clip_gradient", None)
+    return create(name, **kw)

@@ -12,7 +12,6 @@ reference's priority-ordered engine pushes did (trainer.py:190 priority=-i).
 """
 from __future__ import annotations
 
-import functools
 from typing import Callable, Optional
 
 import numpy as np
@@ -40,64 +39,6 @@ def l2_loss(pred, target):
 
 
 _LOSSES = {"softmax_ce": softmax_ce_loss, "l2": l2_loss}
-
-
-# -- functional optimizers ---------------------------------------------------
-# The in-step analog of mxnet_tpu.optimizer: pure (param, grad, state) ->
-# (param, state) rules reusing the registered update ops' math.
-
-def _sgd_init(p):
-    return ()
-
-
-def _sgd_update(p, g, s, lr, momentum=0.0, wd=0.0):
-    g = g.astype(jnp.float32) + wd * p
-    if momentum:
-        (mom,) = s
-        mom = momentum * mom - lr * g
-        return p + mom, (mom,)
-    return p - lr * g, ()
-
-
-def _sgd_mom_init(p):
-    return (jnp.zeros_like(p),)
-
-
-def _adam_init(p):
-    return (jnp.zeros_like(p), jnp.zeros_like(p), jnp.zeros((), jnp.int32))
-
-
-def _adam_update(p, g, s, lr, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0):
-    mean, var, t = s
-    t = t + 1
-    g = g.astype(jnp.float32) + wd * p
-    mean = beta1 * mean + (1 - beta1) * g
-    var = beta2 * var + (1 - beta2) * jnp.square(g)
-    tf = t.astype(jnp.float32)
-    lr_t = lr * jnp.sqrt(1 - beta2 ** tf) / (1 - beta1 ** tf)
-    return p - lr_t * mean / (jnp.sqrt(var) + epsilon), (mean, var, t)
-
-
-def _lars_update(p, g, s, lr, momentum=0.9, wd=0.0, eta=0.001):
-    """LARS layer-wise adaptive rate (reference: LBSGD optimizer.py:648) —
-    the large-batch recipe that the high-MFU regime needs."""
-    (mom,) = s
-    g = g.astype(jnp.float32)
-    w_norm = jnp.linalg.norm(p)
-    g_norm = jnp.linalg.norm(g)
-    trust = jnp.where((w_norm > 0) & (g_norm > 0),
-                      eta * w_norm / (g_norm + wd * w_norm + 1e-9), 1.0)
-    g = trust * (g + wd * p)
-    mom = momentum * mom + lr * g
-    return p - mom, (mom,)
-
-
-_OPTS = {
-    "sgd": (lambda kw: _sgd_mom_init if kw.get("momentum") else _sgd_init,
-            _sgd_update),
-    "adam": (lambda kw: _adam_init, _adam_update),
-    "lars": (lambda kw: _sgd_mom_init, _lars_update),
-}
 
 
 def _remat_staged(staged):
@@ -143,9 +84,12 @@ class TrainStep:
         optimizer_params = dict(optimizer_params or {})
         self.lr = optimizer_params.pop("learning_rate", lr)
         self.lr_schedule = lr_schedule
-        init_f, update_f = _OPTS[optimizer]
-        self._opt_init = init_f(optimizer_params)
-        self._opt_update = functools.partial(update_f, **optimizer_params)
+        self.wd = optimizer_params.pop("wd", 0.0)
+        # any registered optimizer runs inside the fused step — the pure
+        # rules live in functional_opt (the traced analog of optimizer.py)
+        from . import functional_opt
+        self._fopt = functional_opt.create(optimizer, **optimizer_params)
+        self._opt_init = self._fopt.init
         self.mesh = mesh
         self.data_axis = data_axis
         self.compute_dtype = compute_dtype
@@ -208,10 +152,15 @@ class TrainStep:
     def _build_step(self):
         staged = self._staged
         loss_fn = self.loss_fn
-        opt_update = self._opt_update
+        fopt = self._fopt
         trainable = self._trainable
         compute_dtype = self.compute_dtype
         param_objs = self.param_list
+        wd_base = self.wd
+        # per-parameter multipliers are static (gluon Parameter.lr_mult /
+        # wd_mult — reference: gluon/parameter.py), baked into the trace
+        lr_mults = [getattr(p, "lr_mult", 1.0) for p in param_objs]
+        wd_mults = [getattr(p, "wd_mult", 1.0) for p in param_objs]
 
         preprocess = self.preprocess
 
@@ -244,9 +193,18 @@ class TrainStep:
                 fwd, has_aux=True)(pvals)
             # optimizer update on trainable params only
             new_p, new_s = [], []
-            for p, g, s, tr in zip(pvals, grads, opt_state, trainable):
+            for i, (p, g, s, tr) in enumerate(
+                    zip(pvals, grads, opt_state, trainable)):
                 if tr:
-                    np_, ns_ = opt_update(p, g, s, lr)
+                    # salt the optimizer stream: fold_in(key, i) for small i
+                    # coincides with split(key)[i], which is exactly what the
+                    # staged forward's dropout chain consumes
+                    pkey = jax.random.fold_in(
+                        jax.random.fold_in(key, 0x6F707469), i) \
+                        if fopt.needs_key else None
+                    np_, ns_ = fopt.update(p, g, s, lr * lr_mults[i],
+                                           t + 1, wd_base * wd_mults[i],
+                                           key=pkey)
                     new_p.append(np_.astype(p.dtype))
                     new_s.append(ns_)
                 else:

