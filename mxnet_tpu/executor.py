@@ -27,6 +27,7 @@ _IMPLICIT_LOSS = {}
 
 
 def _register_implicit_losses():
+    import jax
     import jax.numpy as jnp
     from .ops import nn as _nn
 
@@ -44,17 +45,103 @@ def _register_implicit_losses():
         return grad_scale * jnp.sum(
             jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x))))
 
+    def svm_loss(data, label, margin=1.0, regularization_coefficient=1.0,
+                 use_linear=False, **kw):
+        """One-vs-rest hinge loss (reference: src/operator/svm_output.cc
+        L1_SVM/L2_SVM mshadow_op:31-67): the true-class score is pushed
+        above +margin, every other score below -margin, each independently
+        (NOT the Crammer-Singer relative-margin form)."""
+        y = label.astype(jnp.int32)
+        onehot = jax.nn.one_hot(y, data.shape[-1], dtype=data.dtype)
+        pos = jnp.maximum(0.0, margin - data) * onehot
+        neg = jnp.maximum(0.0, margin + data) * (1.0 - onehot)
+        viol = pos + neg
+        per = jnp.sum(viol) if use_linear else jnp.sum(jnp.square(viol))
+        return regularization_coefficient * per
+
     _IMPLICIT_LOSS.update({
         "SoftmaxOutput": _nn.softmax_output_loss,
         "Softmax": _nn.softmax_output_loss,
         "LinearRegressionOutput": linreg_loss,
         "MAERegressionOutput": maereg_loss,
         "LogisticRegressionOutput": logreg_loss,
+        "SVMOutput": svm_loss,
     })
 
 
+def build_graph_fns(sym):
+    """Pure forward / forward-with-implicit-loss functions for a symbol.
+
+    Shared by Executor (separate fwd / fwd+grad jits) and the fused Module
+    step (one fwd+bwd+update program). Returns ``(fwd, fwd_loss,
+    loss_specs)`` where
+
+        fwd(arg_vals, aux_vals, key, training) -> (outs, aux_updates)
+        fwd_loss(arg_vals, aux_vals, head_grads, key)
+            -> (scalar, (outs, aux_updates))
+
+    ``fwd_loss``'s scalar is the sum of the graph's implicit losses
+    (SoftmaxOutput & co — reference: src/operator/softmax_output.cc) plus
+    ``sum(out * head_grad)`` for explicit heads, so its gradient wrt
+    arg_vals is the reference backward.
+    """
+    if not _IMPLICIT_LOSS:
+        _register_implicit_losses()
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+
+    def fwd(arg_vals, aux_vals, key, training):
+        amap = dict(zip(arg_names, arg_vals))
+        amap.update(zip(aux_names, aux_vals))
+        outs, aux_updates = sym.eval_arrays_ex(amap, training=training,
+                                               rng_key=key)
+        return tuple(outs), aux_updates
+
+    heads = sym._output_symbols()
+    loss_specs = []
+    for i, h in enumerate(heads):
+        node = h._node
+        if node.op in _IMPLICIT_LOSS:
+            from .ops.registry import parse_attr
+            attrs = {k: parse_attr(v) for k, v in node.attrs.items()
+                     if not k.startswith("__")}
+            loss_specs.append((i, node, attrs))
+
+    def fwd_loss(arg_vals, aux_vals, head_grads, key):
+        import jax.numpy as jnp
+        amap = dict(zip(arg_names, arg_vals))
+        amap.update(zip(aux_names, aux_vals))
+        outs, aux_updates = sym.eval_arrays_ex(amap, training=True,
+                                               rng_key=key)
+        total = jnp.zeros((), jnp.float32)
+        implicit = {i for i, _, _ in loss_specs}
+        for i, node, attrs in loss_specs:
+            # recompute the loss from the head node's *inputs* (XLA CSE
+            # dedups against the forward eval)
+            ins = []
+            for p, oi in node.inputs:
+                sub = type(sym)(p, oi)
+                ins.append(sub.eval_arrays(amap, training=True,
+                                           rng_key=key)[0])
+            total = total + _IMPLICIT_LOSS[node.op](*ins, **attrs)
+        for i, o in enumerate(outs):
+            if i not in implicit and head_grads is not None and \
+                    head_grads[i] is not None:
+                total = total + jnp.sum(o * head_grads[i])
+        return total, (tuple(outs), aux_updates)
+
+    return fwd, fwd_loss, loss_specs
+
+
 class Executor:
-    """A bound computation graph (reference: executor.py:30)."""
+    """A bound computation graph (reference: executor.py:30).
+
+    When ``_mesh`` is set (by Module for a multi-context bind), inputs named
+    in ``_batch_args`` are placed batch-sharded over the mesh's 'data' axis
+    and everything else replicated before each jitted call — GSPMD then
+    partitions the whole program across the devices, the TPU equivalent of
+    the reference's DataParallelExecutorGroup slicing
+    (executor_group.py:129, decide_slices :267)."""
 
     def __init__(self, symbol, ctx, arg_dict: Dict[str, NDArray],
                  args_grad: Optional[Dict[str, NDArray]], grad_req,
@@ -79,6 +166,8 @@ class Executor:
         self._fwd_jit = None
         self._vjp_fn = None
         self._is_train = False
+        self._mesh = None          # set by Module on multi-context bind
+        self._batch_args = set()   # arg names sharded over the batch axis
 
     @property
     def arg_arrays(self):
@@ -96,60 +185,20 @@ class Executor:
     def _build(self):
         import jax
 
-        sym = self._symbol
-        arg_names = self.arg_names
-        aux_names = self.aux_names
-
-        def fwd(arg_vals, aux_vals, key, training):
-            amap = dict(zip(arg_names, arg_vals))
-            amap.update(zip(aux_names, aux_vals))
-            outs, aux_updates = sym.eval_arrays_ex(amap, training=training,
-                                                   rng_key=key)
-            return tuple(outs), aux_updates
-
-        self._fwd_jit = jax.jit(fwd, static_argnums=(3,))
-
-        # implicit-loss backward: sum of per-head implicit losses + explicit
-        # head-gradient path for other outputs
-        heads = sym._output_symbols()
-        loss_specs = []
-        for i, h in enumerate(heads):
-            node = h._node
-            if node.op in _IMPLICIT_LOSS:
-                from .ops.registry import parse_attr
-                attrs = {k: parse_attr(v) for k, v in node.attrs.items()
-                         if not k.startswith("__")}
-                loss_specs.append((i, node, attrs))
+        fwd, fwd_loss, loss_specs = build_graph_fns(self._symbol)
         self._loss_specs = loss_specs
-
-        def fwd_loss(arg_vals, aux_vals, head_grads, key):
-            """Returns scalar pseudo-loss whose grad wrt args is the
-            backward of the graph with implicit losses + sum(out*head_grad)
-            for explicit heads."""
-            import jax.numpy as jnp
-            amap = dict(zip(arg_names, arg_vals))
-            amap.update(zip(aux_names, aux_vals))
-            outs, aux_updates = sym.eval_arrays_ex(amap, training=True,
-                                                   rng_key=key)
-            total = jnp.zeros((), jnp.float32)
-            implicit = {i for i, _, _ in loss_specs}
-            for i, node, attrs in loss_specs:
-                # recompute the loss from the head node's *inputs* (XLA CSE
-                # dedups against the forward eval)
-                ins = []
-                for p, oi in node.inputs:
-                    sub = type(sym)(p, oi)
-                    ins.append(sub.eval_arrays(amap, training=True,
-                                               rng_key=key)[0])
-                total = total + _IMPLICIT_LOSS[node.op](*ins, **attrs)
-            for i, o in enumerate(outs):
-                if i not in implicit and head_grads is not None and \
-                        head_grads[i] is not None:
-                    total = total + jnp.sum(o * head_grads[i])
-            return total, (tuple(outs), aux_updates)
-
+        self._fwd_jit = jax.jit(fwd, static_argnums=(3,))
         self._fwd_loss_grad = jax.jit(jax.grad(fwd_loss, argnums=0,
                                                has_aux=True))
+
+    def _place(self, name, val):
+        """Mesh placement for one argument value (no-op without a mesh)."""
+        if self._mesh is None:
+            return val
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = P("data") if name in self._batch_args else P()
+        return jax.device_put(val, NamedSharding(self._mesh, spec))
 
     # -- execution ------------------------------------------------------------
     def forward(self, is_train=False, **kwargs):
@@ -167,8 +216,10 @@ class Executor:
             self._build()
         self._is_train = is_train
         from . import random as _random
-        arg_vals = tuple(self.arg_dict[n]._data for n in self.arg_names)
-        aux_vals = tuple(self.aux_dict[n]._data for n in self.aux_names)
+        arg_vals = tuple(self._place(n, self.arg_dict[n]._data)
+                         for n in self.arg_names)
+        aux_vals = tuple(self._place(n, self.aux_dict[n]._data)
+                         for n in self.aux_names)
         if self._monitor_callback is not None and self._monitor_all:
             # interpreted pass capturing every op output for the Monitor
             # (reference: GraphExecutor ExecuteMonCallback :1445); slower
@@ -205,8 +256,10 @@ class Executor:
             self._build()
         import jax.numpy as jnp
         from . import random as _random
-        arg_vals = tuple(self.arg_dict[n]._data for n in self.arg_names)
-        aux_vals = tuple(self.aux_dict[n]._data for n in self.aux_names)
+        arg_vals = tuple(self._place(n, self.arg_dict[n]._data)
+                         for n in self.arg_names)
+        aux_vals = tuple(self._place(n, self.aux_dict[n]._data)
+                         for n in self.aux_names)
         if out_grads is None:
             head_grads = None
         else:
@@ -277,8 +330,20 @@ class Executor:
             old = self.aux_dict[name]
             new_aux[name] = old if tuple(old.shape) == tuple(s) \
                 else nd.zeros(s, ctx=self._ctx)
-        return Executor(self._symbol, self._ctx, new_args, new_grads,
-                        self.grad_req, new_aux)
+        new_exec = Executor(self._symbol, self._ctx, new_args, new_grads,
+                            self.grad_req, new_aux)
+        # keep the mesh placement across bucketing reshapes — dropping it
+        # would silently un-shard a multi-context Module
+        new_exec._mesh = self._mesh
+        new_exec._batch_args = set(self._batch_args)
+        if self._mesh is not None:
+            ndev = self._mesh.devices.size
+            for name, s in zip(self.arg_names, arg_shapes):
+                if name in new_exec._batch_args and s and s[0] % ndev:
+                    raise MXNetError(
+                        f"reshaped batch dim of '{name}' ({s[0]}) is not "
+                        f"divisible by the mesh size ({ndev})")
+        return new_exec
 
     @property
     def output_dict(self):

@@ -1,0 +1,276 @@
+"""Fused training step for the symbolic Module path.
+
+The reference's steady-state Module loop is: per-GPU executors run fwd/bwd
+(DataParallelExecutorGroup, reference: python/mxnet/module/executor_group.py
+:129), gradients reduce through KVStore push/pull, and a Python Updater
+applies the optimizer per parameter (module.py:629-651). Here the ENTIRE
+batch — forward, implicit-loss backward, cross-device gradient reduction,
+optimizer update, BatchNorm aux fold — is ONE donated XLA program per
+shape, sharing the graph functions with Executor (executor.build_graph_fns)
+and the pure optimizer rules with the gluon TrainStep
+(parallel.functional_opt). With a mesh, data/label inputs are sharded over
+the 'data' axis and parameters replicated; GSPMD inserts the gradient
+all-reduce exactly where the reference's KVStore did.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..executor import build_graph_fns
+from ..parallel import functional_opt
+
+__all__ = ["FusedSymbolStep"]
+
+
+class FusedSymbolStep:
+    """One-XLA-program fwd+bwd+update for a bound Symbol.
+
+    Owns the parameter / optimizer-state / aux buffers between calls
+    (donated each step). The Module syncs them back into its executor
+    lazily (``sync_to``) when eval/checkpoint paths need them.
+    """
+
+    def __init__(self, symbol, data_names, label_names, param_names,
+                 aux_names, trainable, optimizer, mesh=None,
+                 data_axis="data", compute_dtype=None):
+        self.symbol = symbol
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = list(aux_names)
+        self.data_names = list(data_names)
+        self.label_names = list(label_names)
+        self.param_names = list(param_names)
+        self.input_names = [n for n in self.arg_names
+                            if n not in set(param_names)]
+        self.trainable = dict(trainable)  # param name -> bool
+        self.mesh = mesh
+        self.data_axis = data_axis
+        # bf16 compute with fp32 master params/aux — the fused analog of
+        # the optimizer's multi_precision path (reference: optimizer.py
+        # create_state_multi_precision :247)
+        self.compute_dtype = jnp.dtype(compute_dtype) \
+            if compute_dtype is not None else None
+        self.optimizer = optimizer
+        self._fopt = functional_opt.from_optimizer(optimizer)
+        # static per-parameter multipliers (Optimizer._get_lr/_get_wd
+        # with idx2name semantics — reference: optimizer.py:411-432)
+        self._lr_mults = [optimizer.lr_mult.get(n, 1.0)
+                          for n in self.param_names]
+        self._wd_eff = [optimizer.wd * optimizer.wd_mult.get(n, 1.0)
+                        for n in self.param_names]
+        _, self._fwd_loss, _ = build_graph_fns(symbol)
+        from .. import random as _random
+        self._base_key = _random.next_key()
+        self._pvals = None
+        self._opt_state = None
+        self._aux_vals = None
+        self._t_dev = None
+        self._step_jit = None
+        self._lr_cache = None
+        self.num_update = 0
+
+    @property
+    def started(self):
+        return self._pvals is not None
+
+    # -- state ----------------------------------------------------------------
+    def start(self, arg_dict, aux_dict):
+        """Capture initial parameter/aux values (copies — our buffers get
+        donated, the executor's must stay live for eval paths)."""
+        rep = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep = NamedSharding(self.mesh, P())
+
+        def _prep(v):
+            v = jnp.array(v, copy=True)
+            return jax.device_put(v, rep) if rep is not None else v
+
+        self._pvals = tuple(_prep(arg_dict[n]._data)
+                            for n in self.param_names)
+        self._aux_vals = tuple(_prep(aux_dict[n]._data)
+                               for n in self.aux_names)
+        self._opt_state = tuple(
+            tuple(jax.device_put(x, rep) if rep is not None else x
+                  for x in self._fopt.init(v))
+            if self.trainable.get(n, True) else ()
+            for n, v in zip(self.param_names, self._pvals))
+        t0 = jnp.zeros((), jnp.uint32)
+        self._t_dev = jax.device_put(t0, rep) if rep is not None else t0
+
+    def _build(self):
+        fwd_loss = self._fwd_loss
+        fopt = self._fopt
+        arg_names = self.arg_names
+        param_pos = {n: i for i, n in enumerate(self.param_names)}
+        input_pos = {n: i for i, n in enumerate(self.input_names)}
+        trainable = [self.trainable.get(n, True) for n in self.param_names]
+        lr_mults, wd_eff = self._lr_mults, self._wd_eff
+        base_key = self._base_key
+
+        cdt = self.compute_dtype
+
+        def _cast(v):
+            return v.astype(cdt) if cdt is not None and \
+                v.dtype == jnp.float32 else v
+
+        def step_fn(pvals, opt_state, aux_vals, feed_vals, t, lr):
+            key = jax.random.fold_in(base_key, t)
+
+            def floss(pv):
+                arg_vals = tuple(
+                    _cast(pv[param_pos[n]]) if n in param_pos
+                    else _cast(feed_vals[input_pos[n]])
+                    for n in arg_names)
+                total, (outs, aux_up) = fwd_loss(
+                    arg_vals, tuple(_cast(a) for a in aux_vals), None, key)
+                return total, (outs, aux_up)
+
+            grads, (outs, aux_up) = jax.grad(floss, has_aux=True)(pvals)
+            new_p, new_s = [], []
+            for i, (p, g, s, tr) in enumerate(
+                    zip(pvals, grads, opt_state, trainable)):
+                if tr:
+                    pkey = jax.random.fold_in(
+                        jax.random.fold_in(key, 0x6F707469), i) \
+                        if fopt.needs_key else None
+                    np_, ns_ = fopt.update(p, g, s, lr * lr_mults[i],
+                                           t + 1, wd_eff[i], key=pkey)
+                    new_p.append(np_.astype(p.dtype))
+                    new_s.append(ns_)
+                else:
+                    new_p.append(p)
+                    new_s.append(s)
+            new_aux = tuple(
+                aux_up.get(n, a).astype(a.dtype)
+                for n, a in zip(self.aux_names, aux_vals))
+            return tuple(new_p), tuple(new_s), new_aux, tuple(outs), t + 1
+
+        donate = (0, 1, 2, 4)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep = NamedSharding(self.mesh, P())
+            batched = NamedSharding(self.mesh, P(self.data_axis))
+            shard_inputs = set(self.data_names) | set(self.label_names)
+            feed_sh = tuple(batched if n in shard_inputs else rep
+                            for n in self.input_names)
+            prep = tuple(rep for _ in self.param_names)
+            srep = tuple(tuple(rep for _ in st) for st in self._opt_state)
+            arep = tuple(rep for _ in self.aux_names)
+            in_shardings = (prep, srep, arep, feed_sh, rep, rep)
+            # pin state outputs to their input layout (keeps donation
+            # zero-copy); leave graph outputs (None) to GSPMD
+            out_shardings = (prep, srep, arep,
+                             None, rep)
+            self._step_jit = jax.jit(step_fn, donate_argnums=donate,
+                                     in_shardings=in_shardings,
+                                     out_shardings=out_shardings)
+        else:
+            self._step_jit = jax.jit(step_fn, donate_argnums=donate)
+
+    # -- run ------------------------------------------------------------------
+    def step(self, feed, lr):
+        """Run one fused step. ``feed``: dict name -> jnp array for every
+        input (data + label [+ states]); ``lr``: host scalar base learning
+        rate (schedule already applied). Returns the graph outputs."""
+        if self._step_jit is None:
+            self._build()
+        feed_vals = []
+        shard_inputs = set(self.data_names) | set(self.label_names)
+        for n in self.input_names:
+            if n not in feed:
+                raise MXNetError(f"fused step missing input '{n}'")
+            v = feed[n]
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                spec = P(self.data_axis) if n in shard_inputs else P()
+                v = jax.device_put(v, NamedSharding(self.mesh, spec))
+            feed_vals.append(v)
+        if self._lr_cache is None or self._lr_cache[0] != lr:
+            lr_dev = jnp.asarray(lr, jnp.float32)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                lr_dev = jax.device_put(
+                    lr_dev, NamedSharding(self.mesh, P()))
+            self._lr_cache = (lr, lr_dev)
+        self._pvals, self._opt_state, self._aux_vals, outs, self._t_dev = \
+            self._step_jit(self._pvals, self._opt_state, self._aux_vals,
+                           tuple(feed_vals), self._t_dev, self._lr_cache[1])
+        self.num_update += 1
+        return outs
+
+    def load_params(self, arg_dict, aux_dict):
+        """Refresh parameter/aux buffers from executor arrays (set_params
+        mid-run); optimizer state is kept, matching the eager Updater."""
+        rep = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep = NamedSharding(self.mesh, P())
+
+        def _prep(v):
+            v = jnp.array(v, copy=True)
+            return jax.device_put(v, rep) if rep is not None else v
+
+        self._pvals = tuple(_prep(arg_dict[n]._data)
+                            for n in self.param_names)
+        self._aux_vals = tuple(_prep(aux_dict[n]._data)
+                               for n in self.aux_names)
+
+    # -- sync -----------------------------------------------------------------
+    def sync_to(self, arg_dict, aux_dict):
+        """Copy current parameter/aux buffers back into executor arrays.
+        Copies, not references — our buffers are donated next step."""
+        for n, v in zip(self.param_names, self._pvals):
+            arg_dict[n]._data = jnp.array(v, copy=True)
+        for n, v in zip(self.aux_names, self._aux_vals):
+            aux_dict[n]._data = jnp.array(v, copy=True)
+
+    # -- optimizer state io ----------------------------------------------------
+    def get_states(self):
+        """Serialized optimizer state (fused layout, self-describing)."""
+        return pickle.dumps({
+            "__mxnet_tpu_fused__": 1,
+            "optimizer": type(self.optimizer).__name__.lower(),
+            "num_update": self.num_update,
+            "state": {n: tuple(np.asarray(x) for x in st)
+                      for n, st in zip(self.param_names, self._opt_state)},
+        })
+
+    def set_states(self, data):
+        obj = pickle.loads(data) if isinstance(data, (bytes, bytearray)) \
+            else data
+        if not (isinstance(obj, dict) and obj.get("__mxnet_tpu_fused__")):
+            raise MXNetError(
+                "optimizer states were saved by the eager Updater path; "
+                "the fused Module step cannot load them. Re-save from a "
+                "fused run, or construct Module with fused=False to resume "
+                "with the eager update loop.")
+        if not self.started:
+            raise MXNetError("call after bind/init (start() not run)")
+        saved_opt = obj.get("optimizer")
+        cur_opt = type(self.optimizer).__name__.lower()
+        if saved_opt is not None and saved_opt != cur_opt:
+            raise MXNetError(
+                f"optimizer states were saved for '{saved_opt}' but the "
+                f"module now runs '{cur_opt}'")
+        self.num_update = obj["num_update"]
+        self._t_dev = jnp.asarray(self.num_update, jnp.uint32)
+        new_state = []
+        for n, cur in zip(self.param_names, self._opt_state):
+            saved = obj["state"].get(n)
+            if saved is None:
+                new_state.append(cur)
+                continue
+            if len(saved) != len(cur):
+                raise MXNetError(
+                    f"saved optimizer state for '{n}' has {len(saved)} "
+                    f"leaves, expected {len(cur)} — optimizer mismatch?")
+            new_state.append(tuple(
+                jnp.asarray(s, dtype=getattr(c, "dtype", jnp.float32))
+                for s, c in zip(saved, cur)))
+        self._opt_state = tuple(new_state)
