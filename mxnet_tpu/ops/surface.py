@@ -1,0 +1,596 @@
+"""Op-surface completion: the remaining reference registration sites.
+
+Every op here closes a specific gap found by ``tools/opdiff.py`` against
+the reference's NNVM_REGISTER_OP / MXNET_REGISTER_OP_PROPERTY sites:
+
+- output heads: SVMOutput (src/operator/svm_output.cc), the regression
+  outputs (src/operator/regression_output.cc) — forward ops; their
+  implicit-loss backward lives in executor._IMPLICIT_LOSS,
+- tensor utilities: reshape_like, round, _hypot, cast_storage,
+  _slice_assign[_scalar], _scatter_* (src/operator/tensor/),
+- sparse-aware kernels in their dense form: _sparse_retain, _square_sum,
+  _sparse_adagrad_update (src/operator/tensor/sparse_retain.cc,
+  square_sum-inl.h) — the row_sparse NDArray layer reuses these,
+- multi-precision SGD: mp_sgd_update / mp_sgd_mom_update
+  (src/operator/optimizer_op.cc),
+- per-element distribution sampling: _sample_uniform/normal/gamma/
+  exponential/poisson/negative_binomial/generalized_negative_binomial
+  (src/operator/random/sample_op.cc),
+- image ops: _image_to_tensor/_image_normalize (src/operator/image/
+  image_random.cc) and the host-side _cvimdecode/_cvimread/_cvimresize/
+  _cvcopyMakeBorder (plugin/opencv — eager-only, like the reference),
+- contrib: quadratic, box_iou, bipartite_matching, SparseEmbedding
+  (src/operator/contrib/), and the INT8 quantization family
+  (src/operator/quantization/) backed by contrib.quantization's math,
+- KL sparsity regularizer IdentityAttachKLSparseReg
+  (src/operator/regression_output.cc sibling, identity_attach_KL_sparse_reg.cc).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, alias, get_op
+
+# ---------------------------------------------------------------------------
+# output heads
+# ---------------------------------------------------------------------------
+
+
+@register_op("SVMOutput")
+def svm_output(data, label=None, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False, **kw):
+    """Forward = identity scores (src/operator/svm_output.cc:45); the hinge
+    backward is an implicit loss (executor._IMPLICIT_LOSS)."""
+    return data
+
+
+@register_op("LinearRegressionOutput")
+def linear_regression_output(data, label=None, grad_scale=1.0, **kw):
+    return data
+
+
+@register_op("MAERegressionOutput")
+def mae_regression_output(data, label=None, grad_scale=1.0, **kw):
+    return data
+
+
+@register_op("LogisticRegressionOutput")
+def logistic_regression_output(data, label=None, grad_scale=1.0, **kw):
+    return jax.nn.sigmoid(data)
+
+
+@register_op("IdentityAttachKLSparseReg")
+def identity_attach_kl_sparse_reg(data, sparseness_target=0.1, penalty=0.001,
+                                  momentum=0.9, **kw):
+    """Identity with a KL sparsity penalty on the gradient (reference:
+    src/operator/identity_attach_KL_sparse_reg.cc). The reference smooths
+    the per-unit mean activation in an aux state with ``momentum``; here
+    the penalty uses the current batch's mean (documented deviation — the
+    functional graph has no op-local mutable aux)."""
+
+    @jax.custom_vjp
+    def _f(x):
+        return x
+
+    def _fwd(x):
+        rho_hat = jnp.clip(jnp.mean(x, axis=0), 1e-6, 1 - 1e-6)
+        return x, (rho_hat, x.shape[0])
+
+    def _bwd(res, g):
+        rho_hat, n = res
+        kl_grad = penalty * (-sparseness_target / rho_hat +
+                             (1 - sparseness_target) / (1 - rho_hat))
+        return (g + jnp.broadcast_to(kl_grad, g.shape) / n,)
+
+    _f.defvjp(_fwd, _bwd)
+    return _f(data)
+
+
+# ---------------------------------------------------------------------------
+# tensor utilities
+# ---------------------------------------------------------------------------
+
+@register_op("reshape_like")
+def reshape_like(lhs, rhs, **kw):
+    return lhs.reshape(rhs.shape)
+
+
+@register_op("round")
+def round_(data, **kw):
+    # half away from zero (mshadow_op::round), not numpy's half-to-even
+    return jnp.sign(data) * jnp.floor(jnp.abs(data) + 0.5)
+
+
+@register_op("_hypot", aliases=["hypot"])
+def hypot(lhs, rhs, **kw):
+    return jnp.hypot(lhs, rhs)
+
+
+@register_op("_hypot_scalar", aliases=["hypot_scalar"])
+def hypot_scalar(data, scalar=0.0, **kw):
+    return jnp.hypot(data, scalar)
+
+
+@register_op("cast_storage")
+def cast_storage(data, stype="default", **kw):
+    """Storage conversion is an NDArray-level concern here (ndarray.sparse
+    tostype); as a graph op on dense values it is the identity, matching
+    the dense->dense case of src/operator/tensor/cast_storage.cc."""
+    return data
+
+
+@register_op("_identity_with_attr_like_rhs")
+def identity_with_attr_like_rhs(lhs, rhs, **kw):
+    return lhs
+
+
+def _slice_tuple(shape, begin, end, step=None):
+    step = step or [None] * len(begin)
+    sl = []
+    for i in range(len(shape)):
+        if i < len(begin):
+            b = begin[i] if begin[i] is not None else None
+            e = end[i] if i < len(end) and end[i] is not None else None
+            s = step[i] if i < len(step) and step[i] is not None else None
+            sl.append(slice(b, e, s))
+        else:
+            sl.append(slice(None))
+    return tuple(sl)
+
+
+@register_op("_slice_assign", aliases=["_crop_assign"])
+def slice_assign(lhs, rhs, begin=(), end=(), step=(), **kw):
+    return lhs.at[_slice_tuple(lhs.shape, begin, end, step)].set(rhs)
+
+
+@register_op("_slice_assign_scalar", aliases=["_crop_assign_scalar"])
+def slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=(), **kw):
+    return data.at[_slice_tuple(data.shape, begin, end, step)].set(scalar)
+
+
+@register_op("_scatter_plus_scalar")
+def scatter_plus_scalar(data, scalar=0.0, **kw):
+    # on dense storage the scatter_ scalar family equals the plain op
+    # (the row_sparse variant touches only stored rows — ndarray.sparse)
+    return data + scalar
+
+
+@register_op("_scatter_minus_scalar")
+def scatter_minus_scalar(data, scalar=0.0, **kw):
+    return data - scalar
+
+
+@register_op("_scatter_elemwise_div")
+def scatter_elemwise_div(lhs, rhs, **kw):
+    return lhs / rhs
+
+
+@register_op("_scatter_set_nd")
+def scatter_set_nd(lhs, rhs, indices, shape=None, **kw):
+    idx = tuple(indices.astype(jnp.int32))
+    return lhs.at[idx].set(rhs)
+
+
+# ---------------------------------------------------------------------------
+# sparse kernels (dense form)
+# ---------------------------------------------------------------------------
+
+@register_op("_sparse_retain", aliases=["sparse_retain"])
+def sparse_retain(data, indices, **kw):
+    """Keep only the given rows, zero the rest (dense semantics of
+    src/operator/tensor/sparse_retain.cc)."""
+    rows = indices.astype(jnp.int32)
+    out = jnp.zeros_like(data)
+    return out.at[rows].set(data[rows])
+
+
+@register_op("_square_sum", aliases=["square_sum"])
+def square_sum(data, axis=None, keepdims=False, **kw):
+    return jnp.sum(jnp.square(data), axis=axis, keepdims=keepdims)
+
+
+@register_op("_sparse_adagrad_update", no_grad=True, num_outputs=2)
+def sparse_adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7,
+                          wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, **kw):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    h_new = history + jnp.square(g)
+    w_new = weight - lr * (g / jnp.sqrt(h_new + epsilon) + wd * weight)
+    return w_new, h_new
+
+
+@register_op("mp_sgd_update", no_grad=True, num_outputs=2)
+def mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=False, **kw):
+    """fp16/bf16 weight + fp32 master (src/operator/optimizer_op.cc
+    MP_SGD_Update)."""
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w32 = weight32 - lr * (g + wd * weight32)
+    return w32.astype(weight.dtype), w32
+
+
+@register_op("mp_sgd_mom_update", no_grad=True, num_outputs=3)
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=False, **kw):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mom_new = momentum * mom - lr * (g + wd * weight32)
+    w32 = weight32 + mom_new
+    return w32.astype(weight.dtype), mom_new, w32
+
+
+# ---------------------------------------------------------------------------
+# per-element distribution sampling (src/operator/random/sample_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _key_or_next(key):
+    if key is None:
+        from ..random import next_key
+        return next_key()
+    return key
+
+def _sample_shape(param, shape):
+    if shape is None:
+        shape = ()
+    elif isinstance(shape, int):
+        shape = (shape,)
+    return tuple(param.shape) + tuple(shape), tuple(shape)
+
+
+def _expand(param, sample_shape):
+    return param.reshape(param.shape + (1,) * len(sample_shape)) \
+        if sample_shape else param
+
+
+@register_op("_sample_uniform", aliases=["sample_uniform"], no_grad=True)
+def sample_uniform(low, high, shape=None, dtype="float32", key=None, **kw):
+    key = _key_or_next(key)
+    out_shape, ss = _sample_shape(low, shape)
+    u = jax.random.uniform(key, out_shape, jnp.float32)
+    return (_expand(low, ss) + u * (_expand(high, ss) - _expand(low, ss))) \
+        .astype(dtype)
+
+
+@register_op("_sample_normal", aliases=["sample_normal"], no_grad=True)
+def sample_normal(mu, sigma, shape=None, dtype="float32", key=None, **kw):
+    key = _key_or_next(key)
+    out_shape, ss = _sample_shape(mu, shape)
+    z = jax.random.normal(key, out_shape, jnp.float32)
+    return (_expand(mu, ss) + z * _expand(sigma, ss)).astype(dtype)
+
+
+@register_op("_sample_gamma", aliases=["sample_gamma"], no_grad=True)
+def sample_gamma(alpha, beta, shape=None, dtype="float32", key=None, **kw):
+    key = _key_or_next(key)
+    out_shape, ss = _sample_shape(alpha, shape)
+    g = jax.random.gamma(key, _expand(alpha, ss), out_shape, jnp.float32)
+    return (g * _expand(beta, ss)).astype(dtype)
+
+
+@register_op("_sample_exponential", aliases=["sample_exponential"],
+             no_grad=True)
+def sample_exponential(lam, shape=None, dtype="float32", key=None, **kw):
+    key = _key_or_next(key)
+    out_shape, ss = _sample_shape(lam, shape)
+    e = jax.random.exponential(key, out_shape, jnp.float32)
+    return (e / _expand(lam, ss)).astype(dtype)
+
+
+@register_op("_sample_poisson", aliases=["sample_poisson"], no_grad=True)
+def sample_poisson(lam, shape=None, dtype="float32", key=None, **kw):
+    key = _key_or_next(key)
+    out_shape, ss = _sample_shape(lam, shape)
+    p = jax.random.poisson(key, _expand(lam, ss), out_shape)
+    return p.astype(dtype)
+
+
+@register_op("_sample_negative_binomial", aliases=["sample_negative_binomial"],
+             no_grad=True)
+def sample_negative_binomial(k, p, shape=None, dtype="float32", key=None,
+                             **kw):
+    key = _key_or_next(key)
+    # gamma-poisson mixture: NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    out_shape, ss = _sample_shape(k, shape)
+    k1, k2 = jax.random.split(key)
+    kk = _expand(k, ss).astype(jnp.float32)
+    pp = _expand(p, ss).astype(jnp.float32)
+    lam = jax.random.gamma(k1, kk, out_shape, jnp.float32) * (1 - pp) / pp
+    return jax.random.poisson(k2, lam, out_shape).astype(dtype)
+
+
+@register_op("_sample_generalized_negative_binomial",
+             aliases=["sample_generalized_negative_binomial"], no_grad=True)
+def sample_gen_negative_binomial(mu, alpha, shape=None, dtype="float32",
+                                 key=None, **kw):
+    key = _key_or_next(key)
+    out_shape, ss = _sample_shape(mu, shape)
+    k1, k2 = jax.random.split(key)
+    mm = _expand(mu, ss).astype(jnp.float32)
+    aa = jnp.maximum(_expand(alpha, ss).astype(jnp.float32), 1e-8)
+    lam = jax.random.gamma(k1, 1.0 / aa, out_shape, jnp.float32) * aa * mm
+    return jax.random.poisson(k2, lam, out_shape).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# image ops
+# ---------------------------------------------------------------------------
+
+@register_op("_image_to_tensor", aliases=["image_to_tensor"])
+def image_to_tensor(data, **kw):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (image_random.cc ToTensor);
+    batched NHWC -> NCHW."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register_op("_image_normalize", aliases=["image_normalize"])
+def image_normalize(data, mean=0.0, std=1.0, **kw):
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    if mean.ndim == 1:
+        mean = mean.reshape((-1, 1, 1))
+        std = std.reshape((-1, 1, 1))
+    return (data - mean) / std
+
+
+def _require_cv2():
+    try:
+        import cv2
+        return cv2
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("OpenCV is required for the _cv* ops") from e
+
+
+@register_op("_cvimdecode", aliases=["imdecode"], no_grad=True)
+def cvimdecode(buf, flag=1, to_rgb=True, **kw):
+    """Host-side JPEG/PNG decode (plugin/opencv cv_api.cc). Eager only —
+    the reference's is a CPU-only op too."""
+    cv2 = _require_cv2()
+    img = cv2.imdecode(np.frombuffer(np.asarray(buf).tobytes(), np.uint8),
+                       int(flag))
+    if img is None:
+        raise ValueError("imdecode: could not decode buffer")
+    if to_rgb and img.ndim == 3:
+        img = img[..., ::-1]
+    return jnp.asarray(img)
+
+
+@register_op("_cvimread", aliases=["imread"], no_grad=True)
+def cvimread(filename, flag=1, to_rgb=True, **kw):
+    cv2 = _require_cv2()
+    img = cv2.imread(filename, int(flag))
+    if img is None:
+        raise ValueError(f"imread: could not read {filename}")
+    if to_rgb and img.ndim == 3:
+        img = img[..., ::-1]
+    return jnp.asarray(img)
+
+
+@register_op("_cvimresize", aliases=["imresize"], no_grad=True)
+def cvimresize(src, w=0, h=0, interp=1, **kw):
+    cv2 = _require_cv2()
+    return jnp.asarray(cv2.resize(np.asarray(src), (int(w), int(h)),
+                                  interpolation=int(interp)))
+
+
+@register_op("_cvcopyMakeBorder", aliases=["copyMakeBorder"], no_grad=True)
+def cvcopy_make_border(src, top=0, bot=0, left=0, right=0, type=0,
+                       value=0.0, **kw):
+    cv2 = _require_cv2()
+    return jnp.asarray(cv2.copyMakeBorder(
+        np.asarray(src), int(top), int(bot), int(left), int(right),
+        int(type), value=value))
+
+
+# ---------------------------------------------------------------------------
+# contrib
+# ---------------------------------------------------------------------------
+
+@register_op("_contrib_quadratic", aliases=["quadratic"])
+def quadratic(data, a=0.0, b=0.0, c=0.0, **kw):
+    """(src/operator/contrib/quadratic_op.cc — the tutorial op)"""
+    return a * jnp.square(data) + b * data + c
+
+
+@register_op("_contrib_box_iou", aliases=["box_iou"])
+def box_iou(lhs, rhs, format="corner", **kw):
+    """Pairwise IoU (src/operator/contrib/bounding_box.cc BoxIoU):
+    lhs (..., N, 4), rhs (..., M, 4) -> (..., N, M)."""
+    def corners(b):
+        if format == "center":
+            x, y, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+            return x - w / 2, y - h / 2, x + w / 2, y + h / 2
+        return b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+
+    lx1, ly1, lx2, ly2 = corners(lhs)
+    rx1, ry1, rx2, ry2 = corners(rhs)
+    lx1, ly1, lx2, ly2 = (t[..., :, None] for t in (lx1, ly1, lx2, ly2))
+    rx1, ry1, rx2, ry2 = (t[..., None, :] for t in (rx1, ry1, rx2, ry2))
+    iw = jnp.maximum(jnp.minimum(lx2, rx2) - jnp.maximum(lx1, rx1), 0.0)
+    ih = jnp.maximum(jnp.minimum(ly2, ry2) - jnp.maximum(ly1, ry1), 0.0)
+    inter = iw * ih
+    area_l = jnp.maximum((lx2 - lx1) * (ly2 - ly1), 0.0)
+    area_r = jnp.maximum((rx2 - rx1) * (ry2 - ry1), 0.0)
+    return inter / jnp.maximum(area_l + area_r - inter, 1e-12)
+
+
+@register_op("_contrib_bipartite_matching", aliases=["bipartite_matching"],
+             no_grad=True, num_outputs=2)
+def bipartite_matching(data, is_ascend=False, threshold=0.5, topk=-1, **kw):
+    """Greedy bipartite matching on a score matrix
+    (src/operator/contrib/bounding_box.cc BipartiteMatching). data
+    (..., N, M); returns (row_match (..., N), col_match (..., M))."""
+    scores = data
+    batched = scores.ndim > 2
+    if not batched:
+        scores = scores[None]
+    flat = scores.reshape(scores.shape[0], -1)
+    N, M = scores.shape[-2], scores.shape[-1]
+    order = jnp.argsort(flat, axis=-1)
+    if not is_ascend:
+        order = order[:, ::-1]
+    k = order.shape[1] if topk is None or topk <= 0 \
+        else min(int(topk) * max(N, M), order.shape[1])
+
+    def match_one(score_f, order_row):
+        def body(i, carry):
+            row_m, col_m = carry
+            idx = order_row[i]
+            r, c = idx // M, idx % M
+            s = score_f[idx]
+            ok = (row_m[r] < 0) & (col_m[c] < 0) & \
+                ((s < threshold) if is_ascend else (s > threshold))
+            row_m = row_m.at[r].set(jnp.where(ok, c, row_m[r]))
+            col_m = col_m.at[c].set(jnp.where(ok, r, col_m[c]))
+            return row_m, col_m
+
+        init = (-jnp.ones((N,), jnp.float32), -jnp.ones((M,), jnp.float32))
+        row_m, col_m = jax.lax.fori_loop(0, k, body, init)
+        return row_m, col_m
+
+    row_m, col_m = jax.vmap(match_one)(flat, order)
+    if not batched:
+        row_m, col_m = row_m[0], col_m[0]
+    else:
+        row_m = row_m.reshape(data.shape[:-2] + (N,))
+        col_m = col_m.reshape(data.shape[:-2] + (M,))
+    return row_m, col_m
+
+
+def _embedding_fwd(data, weight, input_dim=None, output_dim=None,
+                   dtype="float32", sparse_grad=False, **kw):
+    return get_op("Embedding").fn(data, weight, input_dim=input_dim,
+                                  output_dim=output_dim, dtype=dtype, **kw)
+
+
+register_op("_contrib_SparseEmbedding",
+            aliases=["SparseEmbedding"])(_embedding_fwd)
+
+
+# ---------------------------------------------------------------------------
+# INT8 quantization family (src/operator/quantization/*.cc), backed by the
+# same arithmetic as contrib.quantization
+# ---------------------------------------------------------------------------
+
+def _qscale(min_range, max_range):
+    return 127.0 / jnp.maximum(jnp.maximum(jnp.abs(min_range),
+                                           jnp.abs(max_range)), 1e-12)
+
+
+@register_op("_contrib_quantize", aliases=["quantize"], no_grad=True,
+             num_outputs=3)
+def contrib_quantize(data, min_range, max_range, out_type="int8", **kw):
+    scale = _qscale(min_range, max_range)
+    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return q, -amax, amax
+
+
+@register_op("_contrib_dequantize", aliases=["dequantize"], no_grad=True)
+def contrib_dequantize(data, min_range, max_range, out_type="float32", **kw):
+    scale = _qscale(min_range, max_range)
+    return data.astype(jnp.float32) / scale
+
+
+@register_op("_contrib_requantize", aliases=["requantize"], no_grad=True,
+             num_outputs=3)
+def contrib_requantize(data, min_range, max_range, min_calib_range=None,
+                       max_calib_range=None, **kw):
+    """int32 accumulator -> int8 with calibrated range
+    (src/operator/quantization/requantize.cc)."""
+    real = data.astype(jnp.float32) * \
+        jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / (127.0 * 127.0)
+    if min_calib_range is None:
+        max_calib_range = jnp.max(jnp.abs(real))
+        min_calib_range = -max_calib_range
+    scale = _qscale(jnp.asarray(min_calib_range), jnp.asarray(max_calib_range))
+    q = jnp.clip(jnp.round(real * scale), -127, 127).astype(jnp.int8)
+    return q, jnp.asarray(min_calib_range, jnp.float32), \
+        jnp.asarray(max_calib_range, jnp.float32)
+
+
+@register_op("_contrib_quantized_flatten", aliases=["quantized_flatten"],
+             no_grad=True, num_outputs=3)
+def quantized_flatten(data, min_data, max_data, **kw):
+    return data.reshape(data.shape[0], -1), min_data, max_data
+
+
+@register_op("_contrib_quantized_pooling", aliases=["quantized_pooling"],
+             no_grad=True, num_outputs=3)
+def quantized_pooling(data, min_data, max_data, **kw):
+    pooled = get_op("Pooling").fn(data.astype(jnp.float32), **kw)
+    if kw.get("pool_type", "max") == "max":
+        pooled = pooled.astype(data.dtype)
+    else:
+        pooled = jnp.clip(jnp.round(pooled), -127, 127).astype(data.dtype)
+    return pooled, min_data, max_data
+
+
+@register_op("_contrib_quantized_fully_connected",
+             aliases=["quantized_fully_connected"], no_grad=True,
+             num_outputs=3)
+def quantized_fully_connected(data, weight, bias=None, min_data=None, max_data=None,
+                              min_weight=None, max_weight=None,
+                              min_bias=None, max_bias=None, num_hidden=None, no_bias=False,
+                              flatten=True, **kw):
+    """int8 x int8 -> int32 MXU matmul (quantized_fully_connected.cc)."""
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    acc = jax.lax.dot_general(
+        x.astype(jnp.int8), weight.astype(jnp.int8).T,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out_absmax = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data)) * \
+        jnp.maximum(jnp.abs(min_weight), jnp.abs(max_weight))
+    if not no_bias and bias is not None:
+        # bias arrives int8 with its own range; rescale into the
+        # accumulator's scale (127*127 / (|d| * |w|))
+        b_absmax = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias))
+        b_real = bias.astype(jnp.float32) * b_absmax / 127.0
+        acc = acc + jnp.round(b_real * (127.0 * 127.0) /
+                              jnp.maximum(out_absmax, 1e-12)
+                              ).astype(jnp.int32)
+    return acc, -out_absmax, out_absmax
+
+
+@register_op("_contrib_quantized_conv", aliases=["quantized_conv"],
+             no_grad=True, num_outputs=3)
+def quantized_conv(data, weight, bias=None, min_data=None, max_data=None,
+                   min_weight=None, max_weight=None,
+                   min_bias=None, max_bias=None, kernel=None,
+                   stride=None, pad=None, num_filter=None, no_bias=False,
+                   **kw):
+    """int8 conv with int32 accumulation on the MXU
+    (quantized_conv.cc; cf. contrib.quantization._int8_conv)."""
+    ks = tuple(kernel)
+    strides = tuple(stride) if stride else (1,) * len(ks)
+    pads = tuple(pad) if pad else (0,) * len(ks)
+    acc = jax.lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=strides,
+        padding=[(p, p) for p in pads],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.int32)
+    out_absmax = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data)) * \
+        jnp.maximum(jnp.abs(min_weight), jnp.abs(max_weight))
+    if not no_bias and bias is not None:
+        b_absmax = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias))
+        b_real = bias.astype(jnp.float32) * b_absmax / 127.0
+        b_acc = jnp.round(b_real * (127.0 * 127.0) /
+                          jnp.maximum(out_absmax, 1e-12)).astype(jnp.int32)
+        acc = acc + b_acc.reshape((1, -1) + (1,) * len(ks))
+    return acc, -out_absmax, out_absmax
+
+
+# cuDNN-era alias
+alias("BatchNorm", "CuDNNBatchNorm")

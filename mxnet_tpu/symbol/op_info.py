@@ -27,6 +27,12 @@ OP_INPUTS = {
     "MAERegressionOutput": (["data", "label"], []),
     "softmax_cross_entropy": (["data", "label"], []),
     "SVMOutput": (["data", "label"], []),
+    "_contrib_quantized_fully_connected": (
+        ["data", "weight", "bias", "min_data", "max_data", "min_weight",
+         "max_weight", "min_bias", "max_bias"], []),
+    "_contrib_quantized_conv": (
+        ["data", "weight", "bias", "min_data", "max_data", "min_weight",
+         "max_weight", "min_bias", "max_bias"], []),
     "Activation": (["data"], []),
     "LeakyReLU": (["data", "gamma"], []),
     "Pooling": (["data"], []),

@@ -1,0 +1,178 @@
+"""Behavioral tests for the op-surface completion (ops/surface.py) and the
+registry-diff gate (tools/opdiff.py must report zero missing forward ops).
+"""
+import subprocess
+import sys
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+import jax.numpy as jnp
+
+
+def test_opdiff_zero_missing():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "opdiff.py")],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "missing: 0" in r.stdout
+
+
+def test_round_half_away_from_zero():
+    x = nd.array([-2.5, -0.5, 0.5, 1.5, 2.5])
+    np.testing.assert_allclose(nd.round(x).asnumpy(),
+                               [-3., -1., 1., 2., 3.])
+
+
+def test_reshape_like_and_hypot():
+    a = nd.array(np.arange(6, dtype=np.float32))
+    b = nd.array(np.zeros((2, 3), np.float32))
+    assert nd.reshape_like(a, b).shape == (2, 3)
+    np.testing.assert_allclose(
+        nd.hypot(nd.array([3.0]), nd.array([4.0])).asnumpy(), [5.0])
+
+
+def test_slice_assign():
+    x = nd.array(np.zeros((4, 4), np.float32))
+    out = nd._slice_assign(x, nd.array(np.ones((2, 2), np.float32)),
+                           begin=(1, 1), end=(3, 3))
+    expect = np.zeros((4, 4), np.float32)
+    expect[1:3, 1:3] = 1
+    np.testing.assert_allclose(out.asnumpy(), expect)
+    out2 = nd._slice_assign_scalar(x, scalar=7.0, begin=(0,), end=(2,))
+    assert out2.asnumpy()[:2].sum() == 7.0 * 8
+
+
+def test_sparse_retain_and_square_sum():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    kept = nd.sparse_retain(data, nd.array([0, 2]))
+    out = kept.asnumpy()
+    assert out[1].sum() == 0 and out[3].sum() == 0
+    np.testing.assert_allclose(out[0], [0, 1, 2])
+    np.testing.assert_allclose(
+        nd._square_sum(data, axis=1).asnumpy(),
+        (np.arange(12).reshape(4, 3) ** 2).sum(1))
+
+
+def test_sample_ops_shapes_and_moments():
+    mx.random.seed(7)
+    low = nd.array([0.0, 10.0])
+    high = nd.array([1.0, 20.0])
+    s = nd.sample_uniform(low, high, shape=(5000,))
+    assert s.shape == (2, 5000)
+    m = s.asnumpy().mean(axis=1)
+    assert abs(m[0] - 0.5) < 0.05 and abs(m[1] - 15.0) < 0.5
+    mu = nd.array([0.0, 5.0])
+    sig = nd.array([1.0, 0.1])
+    sn = nd.sample_normal(mu, sig, shape=(5000,)).asnumpy()
+    assert abs(sn[0].mean()) < 0.1 and abs(sn[1].mean() - 5.0) < 0.05
+    lam = nd.array([2.0, 8.0])
+    sp = nd.sample_poisson(lam, shape=(4000,)).asnumpy()
+    assert abs(sp[0].mean() - 2.0) < 0.2 and abs(sp[1].mean() - 8.0) < 0.4
+    sg = nd.sample_gamma(nd.array([2.0]), nd.array([3.0]),
+                         shape=(4000,)).asnumpy()
+    assert abs(sg.mean() - 6.0) < 0.5
+
+
+def test_box_iou():
+    l = nd.array([[0, 0, 2, 2], [1, 1, 3, 3]])
+    r = nd.array([[0, 0, 2, 2]])
+    iou = nd.box_iou(l, r).asnumpy()
+    np.testing.assert_allclose(iou[:, 0], [1.0, 1.0 / 7.0], rtol=1e-5)
+
+
+def test_bipartite_matching():
+    score = nd.array([[0.9, 0.1], [0.8, 0.7], [0.3, 0.2]])
+    rows, cols = nd.bipartite_matching(score, threshold=0.5)
+    rows, cols = rows.asnumpy(), cols.asnumpy()
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7 ((1,0) blocked)
+    np.testing.assert_allclose(rows, [0, 1, -1])
+    np.testing.assert_allclose(cols, [0, 1])
+
+
+def test_quantize_dequantize_roundtrip():
+    x = np.random.RandomState(0).uniform(-3, 3, (4, 5)).astype(np.float32)
+    data = nd.array(x)
+    q, qmin, qmax = nd._contrib_quantize(data, nd.array([-3.0]),
+                                         nd.array([3.0]))
+    assert q.asnumpy().dtype == np.int8
+    back = nd._contrib_dequantize(q, qmin, qmax).asnumpy()
+    np.testing.assert_allclose(back, x, atol=3.0 / 127 + 1e-6)
+
+
+def test_quantized_fully_connected_matches_float():
+    rng = np.random.RandomState(1)
+    x = rng.uniform(-1, 1, (8, 16)).astype(np.float32)
+    w = rng.uniform(-1, 1, (4, 16)).astype(np.float32)
+    qx = np.clip(np.round(x * 127), -127, 127).astype(np.int8)
+    qw = np.clip(np.round(w * 127), -127, 127).astype(np.int8)
+    acc, amin, amax = nd._contrib_quantized_fully_connected(
+        nd.array(qx), nd.array(qw), None,
+        nd.array([-1.0]), nd.array([1.0]), nd.array([-1.0]), nd.array([1.0]),
+        num_hidden=4, no_bias=True)
+    real = acc.asnumpy().astype(np.float64) * \
+        float(amax.asnumpy().ravel()[0]) / (127 * 127)
+    np.testing.assert_allclose(real, x @ w.T, atol=0.2)
+
+
+def test_svm_output_implicit_loss_trains():
+    """SVMOutput head trains a linear classifier through Module
+    (reference: tests/python/unittest test for svm semantics)."""
+    rng = np.random.RandomState(0)
+    n, dim, ncls = 160, 8, 3
+    y = rng.randint(0, ncls, n)
+    x = np.eye(dim, dtype=np.float32)[y % dim][:, :dim] * 2 + \
+        rng.normal(scale=0.2, size=(n, dim)).astype(np.float32)
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=ncls, name="fc")
+    sym = mx.sym.SVMOutput(data=fc, name="svm")
+    it = mx.io.NDArrayIter(x, y.astype(np.float32), batch_size=20,
+                           label_name="svm_label")
+    mod = mx.mod.Module(sym, label_names=("svm_label",), context=mx.cpu())
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05,
+                              "rescale_grad": 1.0 / 20},
+            num_epoch=6, eval_metric="acc")
+    score = mod.score(mx.io.NDArrayIter(x, y.astype(np.float32),
+                                        batch_size=20,
+                                        label_name="svm_label"), "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_image_to_tensor_and_normalize():
+    img = nd.array(np.full((4, 6, 3), 255, np.uint8))
+    t = nd._image_to_tensor(img)
+    assert t.shape == (3, 4, 6)
+    np.testing.assert_allclose(t.asnumpy().max(), 1.0)
+    normed = nd._image_normalize(t, mean=(1.0, 1.0, 1.0),
+                                 std=(2.0, 2.0, 2.0))
+    np.testing.assert_allclose(normed.asnumpy(), 0.0, atol=1e-6)
+
+
+def test_kl_sparse_reg_gradient():
+    import jax
+    from mxnet_tpu.ops.surface import identity_attach_kl_sparse_reg
+
+    def f(x):
+        return jnp.sum(identity_attach_kl_sparse_reg(
+            x, sparseness_target=0.1, penalty=0.01))
+
+    x = jnp.full((4, 3), 0.5)
+    g = jax.grad(f)(x)
+    # rho_hat=0.5: kl grad = 0.01 * (-0.1/0.5 + 0.9/0.5) = 0.016; /batch 4
+    np.testing.assert_allclose(np.asarray(g), 1.0 + 0.016 / 4, rtol=1e-5)
+
+
+def test_mp_sgd_updates():
+    w16 = nd.array(np.ones((3,), np.float32)).astype("float16")
+    g16 = nd.array(np.full((3,), 0.5, np.float32)).astype("float16")
+    w32 = nd.array(np.ones((3,), np.float32))
+    nw, nw32 = nd.mp_sgd_update(w16, g16, w32, lr=0.1)
+    np.testing.assert_allclose(nw32.asnumpy(), 0.95, rtol=1e-6)
+    assert nw.asnumpy().dtype == np.float16

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Diff the reference's operator registration surface against mxnet_tpu.
+
+Extracts every forward-op name registered in the reference sources
+(NNVM_REGISTER_OP sites in src/**/*.cc minus backward/grad-only nodes,
+plus MXNET_REGISTER_OP_PROPERTY legacy registrations), then checks each
+against the mxnet_tpu op registry (including aliases). Exit code 1 if any
+are missing.
+
+Usage:
+    python tools/opdiff.py [--reference /root/reference] [-v]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+
+# registration sites that are not user-facing forward ops:
+#  - _backward_* / *_grad: autograd internals (subsumed by jax.vjp)
+#  - _Native/_NDArray: the old C plugin bridge (subsumed by CustomOp)
+#  - _CrossDeviceCopy: engine-internal copy node (subsumed by GSPMD)
+#  - _[c]ached_op etc. internal nodes
+#  - _CachedOp / _CustomFunction: imperative-engine internals (subsumed by
+#    the hybridize jit cache / autograd.Function)
+#  - 'name': macro parameter captured from a registration template in a
+#    header, not an op
+_EXCLUDE = re.compile(
+    r"^(_backward|_grad|_Native$|_NDArray$|_CrossDeviceCopy$|_NoGradient$|"
+    r"_copyto$|_cached_op|_CachedOp$|_CustomFunction$|_broadcast_backward$|"
+    r"_contrib_backward_|name$)")
+
+
+def reference_ops(ref_root):
+    pats = [
+        (re.compile(r"NNVM_REGISTER_OP\(([A-Za-z0-9_]+)\)"), 1),
+        (re.compile(r"MXNET_REGISTER_OP_PROPERTY\(([A-Za-z0-9_]+)\s*,"), 1),
+    ]
+    names = set()
+    for dirpath, _, files in os.walk(os.path.join(ref_root, "src")):
+        for fn in files:
+            if not fn.endswith((".cc", ".cu", ".h")):
+                continue
+            try:
+                text = open(os.path.join(dirpath, fn), errors="ignore").read()
+            except OSError:
+                continue
+            for pat, grp in pats:
+                for m in pat.finditer(text):
+                    names.add(m.group(grp))
+    return {n for n in names if not _EXCLUDE.match(n)}
+
+
+def repo_ops():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from mxnet_tpu.ops.registry import list_ops, get_op
+    names = set(list_ops())
+    # nd/sym namespace aliases count (reference exposes both styles)
+    import mxnet_tpu as mx
+    for ns in (mx.nd, mx.sym):
+        names.update(n for n in dir(ns) if not n.startswith("__"))
+    return names, get_op
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reference", default="/root/reference")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+
+    ref = reference_ops(args.reference)
+    have, get_op = repo_ops()
+
+    def covered(name):
+        if name in have:
+            return True
+        try:
+            get_op(name)
+            return True
+        except Exception:
+            return False
+
+    missing = sorted(n for n in ref if not covered(n))
+    print(f"reference forward-op registrations: {len(ref)}")
+    print(f"covered: {len(ref) - len(missing)}  missing: {len(missing)}")
+    if args.verbose or missing:
+        for n in missing:
+            print(f"  MISSING {n}")
+    return 1 if missing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
