@@ -46,6 +46,17 @@ class AttrScope:
         AttrScope._current.value = self._old_scope
 
 
+def apply_scope_attrs(node):
+    """Merge the active AttrScope's attributes into a graph node's
+    user_attrs (single definition for ops and variables — reference:
+    symbol creation + Variable both consult AttrScope.current)."""
+    scope_attrs = current_attrs()
+    if scope_attrs:
+        merged = dict(scope_attrs)
+        merged.update(node.user_attrs)  # explicit attrs win over scope
+        node.user_attrs = merged
+
+
 def current_attrs():
     scope = getattr(AttrScope._current, "value", None)
     return scope._attr.copy() if scope is not None else {}

@@ -69,7 +69,7 @@ def _register_implicit_losses():
     })
 
 
-def build_graph_fns(sym):
+def build_graph_fns(sym, device_map=None):
     """Pure forward / forward-with-implicit-loss functions for a symbol.
 
     Shared by Executor (separate fwd / fwd+grad jits) and the fused Module
@@ -84,6 +84,10 @@ def build_graph_fns(sym):
     (SoftmaxOutput & co — reference: src/operator/softmax_output.cc) plus
     ``sum(out * head_grad)`` for explicit heads, so its gradient wrt
     arg_vals is the reference backward.
+
+    ``device_map`` routes each node to a group2ctx device (eager-only —
+    see Symbol.eval_arrays_ex); functions built with it must NOT be
+    jitted.
     """
     if not _IMPLICIT_LOSS:
         _register_implicit_losses()
@@ -94,7 +98,8 @@ def build_graph_fns(sym):
         amap = dict(zip(arg_names, arg_vals))
         amap.update(zip(aux_names, aux_vals))
         outs, aux_updates = sym.eval_arrays_ex(amap, training=training,
-                                               rng_key=key)
+                                               rng_key=key,
+                                               device_map=device_map)
         return tuple(outs), aux_updates
 
     heads = sym._output_symbols()
@@ -112,7 +117,8 @@ def build_graph_fns(sym):
         amap = dict(zip(arg_names, arg_vals))
         amap.update(zip(aux_names, aux_vals))
         outs, aux_updates = sym.eval_arrays_ex(amap, training=True,
-                                               rng_key=key)
+                                               rng_key=key,
+                                               device_map=device_map)
         total = jnp.zeros((), jnp.float32)
         implicit = {i for i, _, _ in loss_specs}
         for i, node, attrs in loss_specs:
@@ -122,7 +128,8 @@ def build_graph_fns(sym):
             for p, oi in node.inputs:
                 sub = type(sym)(p, oi)
                 ins.append(sub.eval_arrays(amap, training=True,
-                                           rng_key=key)[0])
+                                           rng_key=key,
+                                           device_map=device_map)[0])
             total = total + _IMPLICIT_LOSS[node.op](*ins, **attrs)
         for i, o in enumerate(outs):
             if i not in implicit and head_grads is not None and \
@@ -145,7 +152,7 @@ class Executor:
 
     def __init__(self, symbol, ctx, arg_dict: Dict[str, NDArray],
                  args_grad: Optional[Dict[str, NDArray]], grad_req,
-                 aux_dict: Dict[str, NDArray]):
+                 aux_dict: Dict[str, NDArray], group2ctx=None):
         if not _IMPLICIT_LOSS:
             _register_implicit_losses()
         self._symbol = symbol
@@ -168,6 +175,8 @@ class Executor:
         self._is_train = False
         self._mesh = None          # set by Module on multi-context bind
         self._batch_args = set()   # arg names sharded over the batch axis
+        self._group2ctx = dict(group2ctx) if group2ctx else None
+        self._device_map = None    # node -> device (group2ctx builds)
 
     @property
     def arg_arrays(self):
@@ -184,6 +193,27 @@ class Executor:
     # -- compilation ----------------------------------------------------------
     def _build(self):
         import jax
+
+        if self._group2ctx:
+            # model parallelism by placement: run the graph EAGERLY so
+            # each op dispatches to the device its data lives on, with
+            # device_put at group boundaries (the reference's
+            # _CrossDeviceCopy, graph_executor.cc:406). jit would pin the
+            # whole program to one device, so this path stays unjitted;
+            # JAX's async dispatch still pipelines the per-op kernels,
+            # and grad traces straight through the transfers.
+            default_dev = self._ctx.jax_device if self._ctx is not None \
+                else None
+            dmap = self._symbol.build_device_map(self._group2ctx,
+                                                 default_dev)
+            self._device_map = dmap
+            fwd, fwd_loss, loss_specs = build_graph_fns(self._symbol,
+                                                        device_map=dmap)
+            self._loss_specs = loss_specs
+            self._fwd_jit = fwd
+            self._fwd_loss_grad = jax.grad(fwd_loss, argnums=0,
+                                           has_aux=True)
+            return
 
         fwd, fwd_loss, loss_specs = build_graph_fns(self._symbol)
         self._loss_specs = loss_specs
@@ -229,7 +259,7 @@ class Executor:
             internals = {}
             outs, aux_updates = self._symbol.eval_arrays_ex(
                 amap, training=bool(is_train), rng_key=_random.next_key(),
-                internals=internals)
+                internals=internals, device_map=self._device_map)
             for name, o in internals.items():
                 self._monitor_callback(name, _wrap(o))
         else:
@@ -288,21 +318,30 @@ class Executor:
         self._monitor_callback = callback
         self._monitor_all = monitor_all
 
+    def assign_array(self, tgt, value):
+        """Rebind an executor array's buffer, preserving its committed
+        device under group2ctx placement (any other write path would
+        silently migrate a placed weight to the default device)."""
+        src = value._data if isinstance(value, NDArray) else value
+        if self._group2ctx is not None:
+            import jax
+            src = jax.device_put(src, list(tgt._data.devices())[0])
+        tgt._data = src
+
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):
-        """(reference: executor.py:326)"""
+        """(reference: executor.py:326); device-preserving under
+        group2ctx placement."""
         for name, array in arg_params.items():
             if name in self.arg_dict:
-                self.arg_dict[name]._data = array._data \
-                    if isinstance(array, NDArray) else array
+                self.assign_array(self.arg_dict[name], array)
             elif not allow_extra_params:
                 raise ValueError(f"Found name \"{name}\" that is not in the "
                                  "arguments")
         if aux_params:
             for name, array in aux_params.items():
                 if name in self.aux_dict:
-                    self.aux_dict[name]._data = array._data \
-                        if isinstance(array, NDArray) else array
+                    self.assign_array(self.aux_dict[name], array)
                 elif not allow_extra_params:
                     raise ValueError(f"Found name \"{name}\" that is not in "
                                      "the auxiliary states")
@@ -311,27 +350,39 @@ class Executor:
         """Return a new executor for new input shapes (reference:
         executor.py:371). XLA recompiles per shape — this is the
         BucketingModule mechanism."""
+        import jax
         from . import ndarray as nd
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+
+        def _alloc_like(old, s):
+            # fresh buffer on the SAME device as the old array (group2ctx
+            # placement survives bucketing reshapes)
+            arr = nd.zeros(s, ctx=self._ctx)
+            if self._group2ctx is not None and old is not None:
+                arr._data = jax.device_put(
+                    arr._data, list(old._data.devices())[0])
+            return arr
+
         new_args = {}
         for name, s in zip(self.arg_names, arg_shapes):
             old = self.arg_dict[name]
             if tuple(old.shape) == tuple(s):
                 new_args[name] = old
             else:
-                new_args[name] = nd.zeros(s, ctx=self._ctx)
+                new_args[name] = _alloc_like(old, s)
         new_grads = {}
         if self.grad_dict:
             for name, s in zip(self.arg_names, arg_shapes):
                 if name in self.grad_dict:
-                    new_grads[name] = nd.zeros(s, ctx=self._ctx)
+                    new_grads[name] = _alloc_like(self.grad_dict[name], s)
         new_aux = {}
         for name, s in zip(self.aux_names, aux_shapes):
             old = self.aux_dict[name]
             new_aux[name] = old if tuple(old.shape) == tuple(s) \
-                else nd.zeros(s, ctx=self._ctx)
+                else _alloc_like(old, s)
         new_exec = Executor(self._symbol, self._ctx, new_args, new_grads,
-                            self.grad_req, new_aux)
+                            self.grad_req, new_aux,
+                            group2ctx=self._group2ctx)
         # keep the mesh placement across bucketing reshapes — dropping it
         # would silently un-shard a multi-context Module
         new_exec._mesh = self._mesh

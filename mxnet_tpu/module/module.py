@@ -63,16 +63,21 @@ class Module(BaseModule):
         if isinstance(context, ctx_mod.Context):
             context = [context]
         self._context = context
-        if group2ctxs is not None:
-            # the reference's PlaceDevice model parallelism
-            # (graph_executor.cc:406); use Symbol.simple_bind(group2ctx=...)
-            # with sharding specs instead — not wired through Module yet,
-            # and silently training on one device would be worse than
-            # refusing (VERDICT r3 "what's weak" #4)
+        if isinstance(group2ctxs, (list, tuple)):
+            # reference shape: one dict per DP context
+            # (executor_group.py group2ctxs); combining DP with placement
+            # is not supported here — raise rather than drop either axis
+            if len(group2ctxs) > 1:
+                raise NotImplementedError(
+                    "group2ctxs with multiple entries (model parallelism "
+                    "replicated across data-parallel contexts) is not "
+                    "supported; use a single group2ctx dict")
+            group2ctxs = group2ctxs[0] if group2ctxs else None
+        if group2ctxs is not None and len(context) > 1:
             raise NotImplementedError(
-                "Module(group2ctxs=...) is not supported; bind the symbol "
-                "directly with sharding specs (see "
-                "examples/model_parallel_lstm) or drop group2ctxs")
+                "group2ctxs cannot be combined with a multi-device ctx "
+                "list; choose data parallelism OR placement")
+        self._group2ctxs = group2ctxs
         if work_load_list is not None and len(set(work_load_list)) > 1:
             raise NotImplementedError(
                 "uneven work_load_list is not supported: GSPMD shards the "
@@ -252,14 +257,15 @@ class Module(BaseModule):
         self._copy_params_to_exec()
 
     def _copy_params_to_exec(self, refresh_fused=True):
+        # Executor.assign_array preserves group2ctx placement
         for name in self._param_names:
             if name in self._arg_params:
-                self._exec.arg_dict[name]._data = \
-                    self._arg_params[name]._data
+                self._exec.assign_array(self._exec.arg_dict[name],
+                                        self._arg_params[name])
         for name in self._aux_names:
             if name in self._aux_params:
-                self._exec.aux_dict[name]._data = \
-                    self._aux_params[name]._data
+                self._exec.assign_array(self._exec.aux_dict[name],
+                                        self._aux_params[name])
         if refresh_fused and self._fused is not None and self._fused.started:
             # set_params/init_params mid-run: push the new values into the
             # fused buffers (optimizer state is kept, like the eager path)
@@ -299,7 +305,8 @@ class Module(BaseModule):
         self._mesh = self._build_mesh()
         self._exec = self._symbol.simple_bind(
             ctx=self._context[0], grad_req=grad_req,
-            shared_buffer=shared_buffer, **shape_kwargs)
+            shared_buffer=shared_buffer, group2ctx=self._group2ctxs,
+            **shape_kwargs)
         if self._mesh is not None:
             self._exec._mesh = self._mesh
             self._exec._batch_args = set(
@@ -403,6 +410,11 @@ class Module(BaseModule):
             blockers.append("inputs_need_grad")
         if self._state_names:
             blockers.append("state_names")
+        if self._group2ctxs:
+            # placement runs the eager per-op path (executor._build
+            # group2ctx branch); one jitted program would collapse the
+            # devices back to one
+            blockers.append("group2ctxs placement")
         if blockers:
             if self._fused_requested:
                 raise MXNetError(

@@ -358,8 +358,14 @@ class NDArray:
             value = value._data
         full = key is None or (isinstance(key, slice) and key == slice(None))
         if full:
-            self._data = jnp.broadcast_to(
+            new = jnp.broadcast_to(
                 jnp.asarray(value, self._data.dtype), self.shape)
+            # keep the array on its committed device (group2ctx-placed
+            # weights must not drift to the default device on x[:] = v)
+            devs = getattr(self._data, "devices", None)
+            if devs is not None and getattr(self._data, "committed", False):
+                new = jax.device_put(new, list(self._data.devices())[0])
+            self._data = new
             return
         key = _convert_key(key)
         self._data = self._data.at[key].set(jnp.asarray(value, self._data.dtype))

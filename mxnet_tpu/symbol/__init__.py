@@ -45,10 +45,8 @@ def _symbol_op(op_name, sym_inputs, attrs, name=None, attr=None):
     node = _Node(op_name, name, attrs=attrs,
                  inputs=[(s._node, s._out_index) for s in sym_inputs],
                  num_outputs=num_outputs, user_attrs=attr)
-    from ..attribute import current_attrs
-    scope_attrs = current_attrs()
-    if scope_attrs:
-        node.user_attrs.update(scope_attrs)
+    from ..attribute import apply_scope_attrs
+    apply_scope_attrs(node)
     return Symbol(node)
 
 

@@ -277,13 +277,34 @@ class Symbol:
         return list(self._group) if self._group is not None else [self]
 
     def eval_arrays(self, arg_arrays: Dict[str, "np.ndarray"],
-                    training=False, rng_key=None):
+                    training=False, rng_key=None, device_map=None):
         """Evaluate outputs given raw arrays for every variable."""
-        outs, _ = self.eval_arrays_ex(arg_arrays, training, rng_key)
+        outs, _ = self.eval_arrays_ex(arg_arrays, training, rng_key,
+                                      device_map=device_map)
         return outs
 
+    def build_device_map(self, group2ctx, default_device=None):
+        """{node_name: jax.Device} from ``__ctx_group__`` annotations +
+        a group->Context mapping (the PlaceDevice pass, reference
+        graph_executor.cc:406; AttrScope(ctx_group=...) attribute.py)."""
+        dmap = {}
+        known = set(group2ctx or ())
+        for node in self._topo_nodes():
+            grp = node.user_attrs.get("__ctx_group__")
+            if grp is not None:
+                if grp not in known:
+                    raise MXNetError(
+                        f"node '{node.name}' is annotated with "
+                        f"ctx_group='{grp}' but group2ctx only maps "
+                        f"{sorted(known)}")
+                dmap[node.name] = group2ctx[grp].jax_device
+            elif default_device is not None:
+                dmap[node.name] = default_device
+        return dmap
+
     def eval_arrays_ex(self, arg_arrays: Dict[str, "np.ndarray"],
-                      training=False, rng_key=None, internals=None):
+                      training=False, rng_key=None, internals=None,
+                      device_map=None):
         """Evaluate; returns (outputs, aux_updates).
 
         ``internals``: optional dict filled with every op node's outputs
@@ -294,7 +315,14 @@ class Symbol:
         Dropout active); each stochastic node draws a key folded from
         ``rng_key``. ``aux_updates`` maps aux var name → new value (BatchNorm
         running stats), the functional form of the reference's in-place aux
-        mutation (batch_norm.cc)."""
+        mutation (batch_norm.cc).
+
+        ``device_map``: optional {node_name: jax.Device} from a group2ctx
+        bind (the PlaceDevice pass, reference graph_executor.cc:406).
+        Inputs crossing into a differently-placed node get a
+        ``jax.device_put`` — the ``_CrossDeviceCopy`` analog — and eager
+        dispatch then runs each op where its data lives. Only valid
+        OUTSIDE jit (the group2ctx Executor path runs unjitted)."""
         import jax
         import jax.numpy as jnp
         cache: Dict[tuple, object] = {}
@@ -312,6 +340,10 @@ class Symbol:
                 cache[key] = val
                 return val
             ins = [node_out(p, i) for p, i in node.inputs]
+            if device_map is not None:
+                dev = device_map.get(node.name)
+                if dev is not None:
+                    ins = [jax.device_put(v, dev) for v in ins]
             attrs = {k: parse_attr(v) for k, v in node.attrs.items()
                      if not k.startswith("__")}
             opdef = get_op(node.op)
@@ -473,21 +505,36 @@ class Symbol:
         arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
         arg_names = self.list_arguments()
         aux_names = self.list_auxiliary_states()
+        # group2ctx: variables annotated with ctx_group live on their
+        # group's device (reference: symbol.py:1280-1429 simple_bind
+        # group2ctx -> PlaceDevice); ungrouped ones on the default ctx
+        var_ctx = {}
+        if group2ctx:
+            for node in self._topo_nodes():
+                if node.op is None:
+                    grp = node.user_attrs.get("__ctx_group__")
+                    if grp is not None and grp in group2ctx:
+                        var_ctx[node.name] = group2ctx[grp]
+
+        def _alloc(n, s):
+            return nd.zeros(s, ctx=var_ctx.get(n, ctx))
+
         args = {}
         for n, s in zip(arg_names, arg_shapes):
             if shared_buffer is not None and n in shared_buffer:
                 args[n] = shared_buffer[n]
             else:
-                args[n] = nd.zeros(s, ctx=ctx)
+                args[n] = _alloc(n, s)
                 if shared_buffer is not None:
                     shared_buffer[n] = args[n]
         args_grad = {}
         if grad_req != "null":
             for n, s in zip(arg_names, arg_shapes):
-                args_grad[n] = nd.zeros(s, ctx=ctx)
-        aux_states = {n: nd.zeros(s, ctx=ctx)
+                args_grad[n] = _alloc(n, s)
+        aux_states = {n: _alloc(n, s)
                       for n, s in zip(aux_names, aux_shapes)}
-        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
@@ -501,7 +548,7 @@ class Symbol:
         if isinstance(aux_states, (list, tuple)):
             aux_states = dict(zip(self.list_auxiliary_states(), aux_states))
         return Executor(self, ctx, args or {}, args_grad, grad_req,
-                        aux_states or {})
+                        aux_states or {}, group2ctx=group2ctx)
 
     def eval(self, ctx=None, **kwargs):
         return self.bind(ctx, kwargs, grad_req="null").forward()
@@ -627,6 +674,8 @@ def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
     node = _Node(None, name, attrs=attrs)
     if attr:
         node.user_attrs.update(attr)
+    from ..attribute import apply_scope_attrs
+    apply_scope_attrs(node)
     for k, v in kwargs.items():
         if k.startswith("__") and k.endswith("__"):
             node.user_attrs[k] = v

@@ -146,9 +146,13 @@ def test_silent_wrong_device_is_dead():
     with pytest.raises(NotImplementedError, match="work_load_list"):
         mx.mod.Module(sym, context=[mx.cpu(0), mx.cpu(1)],
                       work_load_list=[1, 2])
-    # group2ctxs
+    # DP x placement combination
     with pytest.raises(NotImplementedError, match="group2ctxs"):
-        mx.mod.Module(sym, group2ctxs={"dev1": mx.cpu(0)})
+        mx.mod.Module(sym, context=[mx.cpu(0), mx.cpu(1)],
+                      group2ctxs={"dev1": mx.cpu(2)})
+    with pytest.raises(NotImplementedError, match="group2ctxs"):
+        mx.mod.Module(sym, group2ctxs=[{"dev1": mx.cpu(0)},
+                                       {"dev1": mx.cpu(1)}])
 
 
 def test_degrade_rules():
