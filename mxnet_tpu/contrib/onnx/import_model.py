@@ -52,13 +52,11 @@ def _attrs(node) -> Dict:
 def _tensor_to_np(t):
     """TensorProto-shaped -> numpy."""
     if hasattr(t, "raw_data") and getattr(t, "raw_data", b""):
-        try:
-            from onnx import numpy_helper
-            return numpy_helper.to_array(t)
-        except ImportError:
-            dt = {1: np.float32, 6: np.int32, 7: np.int64,
-                  11: np.float64}.get(getattr(t, "data_type", 1), np.float32)
-            return np.frombuffer(t.raw_data, dt).reshape(tuple(t.dims))
+        # decode locally — onnx.numpy_helper would reject the vendored
+        # subset's message class anyway (different descriptor type)
+        dt = {1: np.float32, 6: np.int32, 7: np.int64,
+              11: np.float64}.get(getattr(t, "data_type", 1), np.float32)
+        return np.frombuffer(t.raw_data, dt).reshape(tuple(t.dims))
     for field, dt in (("float_data", np.float32), ("int64_data", np.int64),
                       ("int32_data", np.int32), ("double_data", np.float64)):
         data = list(getattr(t, field, ()) or ())
@@ -250,14 +248,27 @@ def import_onnx_graph(graph):
 
 
 def import_model(model_file):
-    """Load an .onnx file (reference: import_model.py:import_model).
-    Requires the ``onnx`` package for protobuf parsing."""
+    """Load a real .onnx file (reference: import_model.py:import_model).
+
+    Parsing uses the vendored ONNX IR protobuf subset
+    (proto/onnx_subset.proto — field numbers match upstream onnx.proto,
+    protobuf skips unknown fields), so no ``onnx`` package is needed;
+    falls back to the ``onnx`` package if it is installed and the subset
+    schema ever falls short."""
+    graph = None
     try:
+        from .proto import onnx_subset_pb2 as P
+        model = P.ModelProto()
+        with open(model_file, "rb") as f:
+            model.ParseFromString(f.read())
+        if model.graph.node:
+            graph = model.graph
+    except Exception:
+        pass  # wire-format parse failed; try the onnx package below
+    if graph is None:
+        # parse-level fallback only: conversion errors must propagate
+        # with their own messages, not be masked by a missing-onnx
+        # ImportError
         import onnx
-    except ImportError as e:
-        raise ImportError(
-            "import_model requires the 'onnx' package to parse .onnx "
-            "protobufs; import_onnx_graph accepts an already-parsed "
-            "GraphProto") from e
-    model = onnx.load(model_file)
-    return import_onnx_graph(model.graph)
+        graph = onnx.load(model_file).graph
+    return import_onnx_graph(graph)

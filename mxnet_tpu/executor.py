@@ -234,14 +234,14 @@ class Executor:
     def forward(self, is_train=False, **kwargs):
         """(reference: executor.py:113)"""
         if kwargs:
+            import jax.numpy as jnp
             for name, arr in kwargs.items():
                 if name not in self.arg_dict:
                     raise MXNetError(f"Unknown argument {name}")
-                if isinstance(arr, NDArray):
-                    self.arg_dict[name]._data = arr._data
-                else:
-                    import jax.numpy as jnp
-                    self.arg_dict[name]._data = jnp.asarray(arr)
+                # assign_array keeps group2ctx placement intact
+                self.assign_array(
+                    self.arg_dict[name],
+                    arr if isinstance(arr, NDArray) else jnp.asarray(arr))
         if self._fwd_jit is None:
             self._build()
         self._is_train = is_train

@@ -1,7 +1,9 @@
 """Gluon RNN (reference: python/mxnet/gluon/rnn/__init__.py)."""
 from .rnn_cell import *  # noqa: F401,F403
 from .rnn_layer import *  # noqa: F401,F403
+from .conv_rnn_cell import *  # noqa: F401,F403
 
-from . import rnn_cell, rnn_layer
+from . import rnn_cell, rnn_layer, conv_rnn_cell
 
-__all__ = rnn_cell.__all__ + rnn_layer.__all__  # noqa: F405
+__all__ = rnn_cell.__all__ + rnn_layer.__all__ + \
+    conv_rnn_cell.__all__  # noqa: F405

@@ -1,0 +1,158 @@
+"""Convolutional recurrent cells (ConvRNN / ConvLSTM / ConvGRU).
+
+Capability rebuild of the reference's convolutional cell family
+(reference: python/mxnet/rnn/rnn_cell.py — BaseConvRNNCell :1094,
+ConvRNNCell :1176, ConvLSTMCell :1253 [Shi et al., NIPS 2015],
+ConvGRUCell :1348): the i2h/h2h projections are convolutions over
+spatial feature maps instead of dense matmuls, so states carry
+(batch, hidden, H, W). Convs lower to ``lax.conv_general_dilated``
+on the MXU like every other conv in the framework.
+"""
+from __future__ import annotations
+
+from .rnn_cell import HybridRecurrentCell
+
+__all__ = ["ConvRNNCell", "ConvLSTMCell", "ConvGRUCell"]
+
+
+def _pair(x):
+    return tuple(x) if isinstance(x, (tuple, list)) else (x, x)
+
+
+class _BaseConvRNNCell(HybridRecurrentCell):
+    """Shared conv-projection machinery (reference: rnn_cell.py:1094)."""
+
+    _num_gates = 1
+
+    def __init__(self, input_shape, hidden_size, i2h_kernel=(3, 3),
+                 i2h_stride=(1, 1), i2h_pad=(1, 1), i2h_dilate=(1, 1),
+                 h2h_kernel=(3, 3), h2h_dilate=(1, 1),
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 activation="tanh", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_shape = tuple(input_shape)  # (C, H, W)
+        self._hidden_size = hidden_size
+        self._i2h_kernel = _pair(i2h_kernel)
+        self._i2h_stride = _pair(i2h_stride)
+        self._i2h_pad = _pair(i2h_pad)
+        self._i2h_dilate = _pair(i2h_dilate)
+        self._h2h_kernel = _pair(h2h_kernel)
+        self._h2h_dilate = _pair(h2h_dilate)
+        # h2h padding preserves the state's spatial shape
+        # (reference: rnn_cell.py:1147 h2h_pad from dilate*(kernel-1)//2)
+        self._h2h_pad = tuple(d * (k - 1) // 2 for k, d in
+                              zip(self._h2h_kernel, self._h2h_dilate))
+        self._activation = activation
+        c, h, w = self._input_shape
+        self._state_shape = (
+            hidden_size,
+            (h + 2 * self._i2h_pad[0] -
+             self._i2h_dilate[0] * (self._i2h_kernel[0] - 1) - 1)
+            // self._i2h_stride[0] + 1,
+            (w + 2 * self._i2h_pad[1] -
+             self._i2h_dilate[1] * (self._i2h_kernel[1] - 1) - 1)
+            // self._i2h_stride[1] + 1)
+        ng = self._num_gates
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(ng * hidden_size, c) + self._i2h_kernel,
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight",
+            shape=(ng * hidden_size, hidden_size) + self._h2h_kernel,
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(ng * hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(ng * hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size,) + self._state_shape,
+                 "__layout__": "NCHW"}] * self._num_states
+
+    def _conv_forward(self, F_, inputs, states, i2h_weight, h2h_weight,
+                      i2h_bias, h2h_bias):
+        ng = self._num_gates
+        i2h = F_.Convolution(inputs, i2h_weight, i2h_bias,
+                             kernel=self._i2h_kernel,
+                             stride=self._i2h_stride,
+                             pad=self._i2h_pad,
+                             dilate=self._i2h_dilate,
+                             num_filter=ng * self._hidden_size)
+        h2h = F_.Convolution(states[0], h2h_weight, h2h_bias,
+                             kernel=self._h2h_kernel,
+                             stride=(1, 1),
+                             pad=self._h2h_pad,
+                             dilate=self._h2h_dilate,
+                             num_filter=ng * self._hidden_size)
+        return i2h, h2h
+
+    def _act(self, F_, x):
+        return F_.Activation(x, act_type=self._activation) \
+            if isinstance(self._activation, str) else self._activation(x)
+
+
+class ConvRNNCell(_BaseConvRNNCell):
+    """(reference: rnn_cell.py:1176)"""
+
+    _num_gates = 1
+    _num_states = 1
+
+    def _alias(self):
+        return "conv_rnn"
+
+    def hybrid_forward(self, F_, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F_, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        out = self._act(F_, i2h + h2h)
+        return out, [out]
+
+
+class ConvLSTMCell(_BaseConvRNNCell):
+    """Convolutional LSTM (Shi et al., NIPS 2015; reference:
+    rnn_cell.py:1253)."""
+
+    _num_gates = 4
+    _num_states = 2
+
+    def _alias(self):
+        return "conv_lstm"
+
+    def hybrid_forward(self, F_, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F_, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        sg = gates.split(num_outputs=4, axis=1)
+        in_gate = F_.Activation(sg[0], act_type="sigmoid")
+        forget_gate = F_.Activation(sg[1], act_type="sigmoid")
+        in_transform = self._act(F_, sg[2])
+        out_gate = F_.Activation(sg[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * self._act(F_, next_c)
+        return next_h, [next_h, next_c]
+
+
+class ConvGRUCell(_BaseConvRNNCell):
+    """(reference: rnn_cell.py:1348)"""
+
+    _num_gates = 3
+    _num_states = 1
+
+    def _alias(self):
+        return "conv_gru"
+
+    def hybrid_forward(self, F_, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F_, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        i2h_r, i2h_z, i2h_n = i2h.split(num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h_n = h2h.split(num_outputs=3, axis=1)
+        reset = F_.Activation(i2h_r + h2h_r, act_type="sigmoid")
+        update = F_.Activation(i2h_z + h2h_z, act_type="sigmoid")
+        cand = self._act(F_, i2h_n + reset * h2h_n)
+        next_h = (1.0 - update) * cand + update * states[0]
+        return next_h, [next_h]

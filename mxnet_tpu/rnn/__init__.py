@@ -8,8 +8,10 @@ against ``mx.rnn``.
 from .io import BucketSentenceIter
 from ..gluon.rnn import (RNNCell, LSTMCell, GRUCell, SequentialRNNCell,
                          DropoutCell, ZoneoutCell, ResidualCell,
-                         BidirectionalCell)
+                         BidirectionalCell, ConvRNNCell, ConvLSTMCell,
+                         ConvGRUCell)
 
 __all__ = ["BucketSentenceIter", "RNNCell", "LSTMCell", "GRUCell",
            "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
-           "ResidualCell", "BidirectionalCell"]
+           "ResidualCell", "BidirectionalCell", "ConvRNNCell",
+           "ConvLSTMCell", "ConvGRUCell"]

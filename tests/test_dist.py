@@ -68,3 +68,20 @@ def test_dist_sync_kvstore_two_processes(tmp_path):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
         assert (tmp_path / f"ok_{rank}").exists(), out[-2000:]
+
+
+def test_dist_sync_kvstore_four_processes(tmp_path):
+    """The reference nightly runs 4 workers (tests/nightly/test_all.sh:55
+    `--launcher local -n 4`); mirror that scale: push/pull, server-side
+    optimizer, row_sparse pulls, and 2-bit compression across 4 real
+    processes."""
+    ok, procs, outs = _run_workers(tmp_path, 4)
+    if not ok:
+        for r in range(4):
+            f = tmp_path / f"ok_{r}"
+            if f.exists():
+                f.unlink()
+        ok, procs, outs = _run_workers(tmp_path, 4)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+        assert (tmp_path / f"ok_{rank}").exists(), out[-2000:]

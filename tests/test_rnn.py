@@ -125,3 +125,71 @@ def test_bucket_sentence_iter():
     d = batch.data[0].asnumpy()
     l = batch.label[0].asnumpy()
     np.testing.assert_array_equal(d[:, 1:], l[:, :-1])
+
+
+class TestConvCells:
+    """Convolutional recurrent cells (reference: rnn_cell.py
+    ConvRNNCell:1176, ConvLSTMCell:1253, ConvGRUCell:1348)."""
+
+    def _run(self, cell, n_states):
+        import numpy as np
+        cell.initialize()
+        x = mx.nd.array(np.random.RandomState(0)
+                        .rand(2, 3, 8, 8).astype(np.float32))
+        states = cell.begin_state(batch_size=2)
+        assert len(states) == n_states
+        out, new_states = cell(x, states)
+        assert out.shape == (2, 5, 8, 8)
+        for s in new_states:
+            assert s.shape == (2, 5, 8, 8)
+        # roll 3 steps: values stay finite and state actually changes
+        prev = new_states
+        for _ in range(3):
+            out, prev = cell(x, prev)
+        assert np.isfinite(out.asnumpy()).all()
+        assert abs(prev[0].asnumpy() - new_states[0].asnumpy()).max() > 0
+
+    def test_conv_lstm(self):
+        self._run(mx.rnn.ConvLSTMCell(input_shape=(3, 8, 8), hidden_size=5,
+                                      prefix="clstm_"), 2)
+
+    def test_conv_rnn(self):
+        self._run(mx.rnn.ConvRNNCell(input_shape=(3, 8, 8), hidden_size=5,
+                                     prefix="crnn_"), 1)
+
+    def test_conv_gru(self):
+        self._run(mx.rnn.ConvGRUCell(input_shape=(3, 8, 8), hidden_size=5,
+                                     prefix="cgru_"), 1)
+
+    def test_conv_lstm_unroll_trains(self):
+        import numpy as np
+        from mxnet_tpu.parallel.step import TrainStep
+        import mxnet_tpu.gluon as gluon
+
+        class Seq(gluon.HybridBlock):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                with self.name_scope():
+                    self.cell = mx.rnn.ConvLSTMCell(
+                        input_shape=(1, 6, 6), hidden_size=4)
+                    self.out = gluon.nn.Dense(2)
+
+            def hybrid_forward(self, F_, x):
+                states = self.cell.begin_state(
+                    batch_size=x.shape[0], func=F_.zeros)
+                o = None
+                for t in range(3):
+                    o, states = self.cell(
+                        x.slice_axis(axis=1, begin=t, end=t + 1), states)
+                return self.out(o.reshape((x.shape[0], -1)))
+
+        net = Seq(prefix="seqclstm_")
+        net.initialize()
+        step = TrainStep(net, loss="l2", optimizer="adam", lr=0.01)
+        rng = np.random.RandomState(0)
+        x = mx.nd.array(rng.rand(4, 3, 6, 6).astype(np.float32))
+        y = mx.nd.array(rng.rand(4, 2).astype(np.float32))
+        l0 = float(step(x, y).asnumpy())
+        for _ in range(15):
+            l = float(step(x, y).asnumpy())
+        assert l < l0

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Convert a reference MXNet ``.params`` checkpoint into a model_zoo
+drop-in.
+
+The ``.params`` container format is byte-compatible with the reference
+(ndarray/param_file.py, verified against hand-assembled reference bytes in
+tests/test_params_interop.py), so any checkpoint produced by the reference
+loads directly. Reference checkpoints name parameters in one of three
+conventions:
+
+1. structural dotted names — ``gluon.Block.save_parameters``
+   (reference block.py),
+2. flat gluon names, with or without the per-instance name_scope prefix —
+   ``ParameterDict.save(strip_prefix=...)`` / ``Block.save_params``
+   (what the reference model_zoo S3 files use),
+3. ``arg:``/``aux:``-tagged flat names — ``Module.save_checkpoint``
+   (reference python/mxnet/model.py).
+
+This script aligns any of them onto a freshly-constructed model_zoo
+network and writes STRUCTURAL names (what ``get_model(name,
+pretrained=True)`` loads via load_parameters) to the local model store
+(reference: the sha1-verified S3 store in gluon/model_zoo/model_store.py
+— this environment has no egress, so conversion replaces download).
+
+Usage:
+    python tools/convert_params.py --params ref_checkpoint.params \
+        --model resnet18_v1 [--classes 1000] [--out PATH]
+
+Default --out: $MXNET_TPU_MODEL_ZOO/<model>.params (or
+~/.mxnet_tpu/models/<model>.params).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _strip_instance_prefix(names):
+    """Remove a shared leading '<token>_' instance prefix (gluon's
+    name_scope counter, e.g. 'resnetv10_') when every name carries it."""
+    names = list(names)
+    if not names:
+        return names
+    first = names[0].split("_", 1)
+    if len(first) < 2:
+        return names
+    prefix = first[0] + "_"
+    if all(n.startswith(prefix) for n in names):
+        return [n[len(prefix):] for n in names]
+    return names
+
+
+def remap_to_structural(src_names, structural_names, flat_names):
+    """Map checkpoint names -> the model's structural names.
+
+    ``structural_names`` and ``flat_names`` are parallel lists (same
+    Parameter order). Tries, in order: structural match, flat match,
+    flat match after stripping each side's instance prefix. Raises with
+    the leftovers rather than guessing by position.
+    """
+    cleaned = [n.split(":", 1)[1] if n.startswith(("arg:", "aux:")) else n
+               for n in src_names]
+    orig_by_clean = dict(zip(cleaned, src_names))
+
+    for dst_names in (structural_names, flat_names):
+        if set(cleaned) == set(dst_names):
+            to_struct = dict(zip(dst_names, structural_names))
+            return {orig_by_clean[c]: to_struct[c] for c in cleaned}
+
+    src_core = _strip_instance_prefix(sorted(cleaned))
+    dst_core = _strip_instance_prefix(sorted(flat_names))
+    core_to_src = dict(zip(src_core, sorted(cleaned)))
+    flat_to_struct = dict(zip(flat_names, structural_names))
+    core_to_struct = {c: flat_to_struct[f]
+                      for c, f in zip(dst_core, sorted(flat_names))}
+    if set(src_core) == set(dst_core):
+        return {orig_by_clean[core_to_src[c]]: core_to_struct[c]
+                for c in src_core}
+    missing = sorted(set(dst_core) - set(src_core))[:5]
+    extra = sorted(set(src_core) - set(dst_core))[:5]
+    raise SystemExit(
+        f"cannot align parameter names: model expects {missing}... not in "
+        f"checkpoint; checkpoint has {extra}... not in model")
+
+
+def convert(params_path, model, classes=1000, out=None):
+    import numpy as np
+
+    import mxnet_tpu.ndarray as nd
+    from mxnet_tpu.ndarray import param_file
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    arrays, names = param_file.load_params(params_path)
+    net = vision.get_model(model, classes=classes, pretrained=False)
+    net.initialize()
+    # materialize deferred shapes
+    net(nd.array(np.zeros((1, 3, 224, 224), np.float32)))
+    structural = net._collect_params_with_prefix()
+    flat = net.collect_params()
+    mapping = remap_to_structural(list(names), list(structural.keys()),
+                                  list(flat.keys()))
+
+    by_struct = {mapping[n]: a for a, n in zip(arrays, names)}
+    for sname, p in structural.items():
+        if sname not in by_struct:
+            raise SystemExit(f"checkpoint missing parameter {sname}")
+        if tuple(by_struct[sname].shape) != tuple(p.shape):
+            raise SystemExit(
+                f"shape mismatch for {sname}: checkpoint "
+                f"{tuple(by_struct[sname].shape)} vs model "
+                f"{tuple(p.shape)}")
+
+    if out is None:
+        from mxnet_tpu.gluon.model_zoo.model_store import get_model_root
+        os.makedirs(get_model_root(), exist_ok=True)
+        out = os.path.join(get_model_root(), f"{model}.params")
+    ordered = list(structural.keys())
+    param_file.save_params(out, [by_struct[n] for n in ordered], ordered)
+    print(f"wrote {len(ordered)} parameters -> {out}")
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--params", required=True,
+                    help="reference .params checkpoint")
+    ap.add_argument("--model", required=True,
+                    help="model_zoo name, e.g. resnet18_v1")
+    ap.add_argument("--classes", type=int, default=1000)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    convert(args.params, args.model, classes=args.classes, out=args.out)
+
+
+if __name__ == "__main__":
+    main()
