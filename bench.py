@@ -149,11 +149,13 @@ def main():
     pipe_img_s = batch * pipe_steps / pipe_dt
 
     # -- MFU: model FLOPs per step / step time / chip bf16 peak --------------
-    # FLOPs come from XLA's cost analysis of the compiled step when the
-    # backend exposes it (actual fwd+bwd+update FLOPs), else the analytic
-    # 3 x 4.089 GFLOP/img ResNet-50 number.
-    flops_per_step = None
-    flops_src = "xla_cost_analysis"
+    # HEADLINE mfu uses the standard model-FLOPs convention (analytic
+    # 3 x 4.089 GFLOP/img for ResNet-50 training) so the number is
+    # comparable to published MFU figures. XLA's cost analysis of the
+    # compiled step (actual fwd+bwd+update FLOPs incl. padding/layout
+    # waste, ~1.8x higher) is reported separately as hardware utilization.
+    model_flops_per_step = RESNET50_TRAIN_FLOPS_PER_IMG * batch
+    xla_flops_per_step = None
     try:
         lowered = step._step_jit.lower(
             step._pvals, step._opt_state, xb, yb, step._t_dev,
@@ -163,15 +165,14 @@ def main():
             cost = cost[0] if cost else {}
         f = float(cost.get("flops", 0.0)) if cost else 0.0
         if f > 0:
-            flops_per_step = f
+            xla_flops_per_step = f
     except Exception:
         pass
-    if not flops_per_step:
-        flops_per_step = RESNET50_TRAIN_FLOPS_PER_IMG * batch
-        flops_src = "analytic_3x4.089GFLOP_per_img"
 
     peak = _peak_flops(dev)
-    mfu = (flops_per_step / mean_step) / peak if peak else 0.0
+    mfu = (model_flops_per_step / mean_step) / peak if peak else 0.0
+    hw_util = ((xla_flops_per_step / mean_step) / peak
+               if peak and xla_flops_per_step else None)
 
     print(json.dumps({
         "metric": "resnet50_train_throughput_per_chip",
@@ -184,9 +185,11 @@ def main():
         "sync_step_min_s": round(min_step, 5),
         "device": getattr(dev, "device_kind", str(dev)),
         "mfu": round(mfu, 4),
-        "mfu_formula": "flops_per_step / step_time / peak_bf16"
-                       f" [{flops_src}; peak={peak/1e12:.0f}T]",
-        "flops_per_step": flops_per_step,
+        "mfu_formula": "model_flops / step_time / peak_bf16 "
+                       f"[analytic 3x4.089 GFLOP/img; peak={peak/1e12:.0f}T]",
+        "model_flops_per_step": model_flops_per_step,
+        "hw_utilization": round(hw_util, 4) if hw_util else None,
+        "xla_cost_flops_per_step": xla_flops_per_step,
         "host_pipeline_img_s": round(pipe_img_s, 2),
         "host_pipeline_note": "host->device rides a network tunnel in this "
                               "environment; on-host TPU this approaches the "

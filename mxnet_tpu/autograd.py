@@ -123,16 +123,23 @@ class TapeNode:
 
     The analog of the reference's per-op NNVM node + ``AGInfo``
     (include/mxnet/imperative.h:59-95).
+
+    ``fn`` (when present) is the pure jax function over the differentiable
+    inputs — kept so ``grad(create_graph=True)`` can re-differentiate the
+    backward (the reference records backward ops into the graph via
+    Imperative::Backward's create_graph flag, imperative.cc:485).
     """
 
-    __slots__ = ("seq", "vjp_fn", "parents", "n_out", "op_name", "outputs")
+    __slots__ = ("seq", "vjp_fn", "parents", "n_out", "op_name", "outputs",
+                 "fn")
 
-    def __init__(self, vjp_fn, parents, n_out, op_name=""):
+    def __init__(self, vjp_fn, parents, n_out, op_name="", fn=None):
         self.seq = next(_node_counter)
         self.vjp_fn = vjp_fn
         self.parents = parents  # list of NDArray (the *differentiable* inputs)
         self.n_out = n_out
         self.op_name = op_name
+        self.fn = fn
         self.outputs: List[Any] = []  # weak-ish: set by record_op
 
 
@@ -146,7 +153,7 @@ def record_op(op_name: str, fn: Callable, inputs: Sequence, raw_inputs: Sequence
     """
     primals = [x.data if hasattr(x, "data") else x for x in inputs]
     _, vjp_fn = jax.vjp(fn, *primals)
-    node = TapeNode(vjp_fn, list(raw_inputs), len(out_arrays), op_name)
+    node = TapeNode(vjp_fn, list(raw_inputs), len(out_arrays), op_name, fn=fn)
     for i, o in enumerate(out_arrays):
         o._node = node
         o._node_index = i
@@ -294,18 +301,108 @@ def _accumulate_leaf(leaf, g):
 _backward_seq = [0]
 
 
+def _backward_graph(heads, head_grads, variables, train_mode=True):
+    """Backward pass that RECORDS itself: every VJP application runs as a
+    taped eager op (vjp-of-vjp via jax), so the returned gradients are
+    differentiable again — true ``create_graph=True`` semantics (reference:
+    Imperative::Backward with create_graph, src/imperative/imperative.cc:485,
+    exposed through autograd.grad's create_graph flag, autograd.py:270).
+
+    Returns a list of NDArray gradients aligned with ``variables`` (new
+    arrays; ``.grad`` buffers are not touched — reference docstring: grads
+    are "returned as new NDArrays instead of stored into variable.grad").
+    """
+    from .ndarray.ndarray import NDArray, _wrap, _invoke_fn
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+
+    var_idx = {id(v): i for i, v in enumerate(variables)}
+    leaf_grads: Dict[int, Any] = {}
+    cotangents: Dict[tuple, Any] = {}
+    out_nodes = []
+
+    def accum(store, key, g):
+        prev = store.get(key)
+        store[key] = g if prev is None else prev + g  # recorded add
+
+    with record(train_mode=train_mode):
+        for h, hg in zip(heads, head_grads):
+            g = hg if isinstance(hg, NDArray) else _wrap(
+                jnp.ones(h.shape, h.dtype) if hg is None
+                else jnp.asarray(hg))
+            node = getattr(h, "_node", None)
+            if node is None:
+                if id(h) in var_idx:
+                    accum(leaf_grads, id(h), g)
+                continue
+            accum(cotangents, (node.seq, h._node_index), g)
+            out_nodes.append(node)
+
+        for node in _collect_graph(out_nodes):
+            cts, any_ct = [], False
+            for i, o in enumerate(node.outputs):
+                ct = cotangents.pop((node.seq, i), None)
+                if ct is None:
+                    ct = _wrap(jnp.zeros(o.shape, o.dtype))
+                else:
+                    any_ct = True
+                cts.append(ct)
+            if not any_ct:
+                continue
+            if node.fn is None:
+                raise RuntimeError(
+                    f"create_graph=True through op '{node.op_name}': this op "
+                    "does not support higher-order gradients (no stored "
+                    "forward; the reference has the same restriction for ops "
+                    "without backward-of-backward definitions)")
+            nparents = len(node.parents)
+            fwd = node.fn
+
+            def bwd(*args, _fwd=fwd, _np=nparents):
+                prim, cts_ = args[:_np], args[_np:]
+                _, vjp = jax.vjp(_fwd, *prim)
+                return tuple(vjp(tuple(cts_)))
+
+            res = _invoke_fn(f"_backward_{node.op_name}", bwd,
+                             list(node.parents) + cts)
+            if not isinstance(res, tuple):
+                res = (res,)
+            for p, g in zip(node.parents, res):
+                if g is None:
+                    continue
+                pn = getattr(p, "_node", None)
+                if pn is not None:
+                    accum(cotangents, (pn.seq, p._node_index), g)
+                if id(p) in var_idx:
+                    accum(leaf_grads, id(p), g)
+
+    return [leaf_grads.get(id(v)) if leaf_grads.get(id(v)) is not None
+            else _wrap(jnp.zeros(v.shape, v.dtype))
+            for v in variables]
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
     """Return gradients of heads w.r.t. variables (reference: autograd.py:270).
 
-    Note: ``create_graph=True`` (higher-order) is routed through ``jax.grad``
-    composition by the caller; the imperative tape supports first-order here.
+    ``create_graph=True`` records the backward pass itself onto the tape
+    (see ``_backward_graph``), so the returned grads support ``.backward()``
+    / further ``grad()`` calls to arbitrary order.
     """
     from .ndarray.ndarray import NDArray, _wrap
 
     single = isinstance(variables, NDArray)
     if single:
         variables = [variables]
+    if create_graph:
+        grads = _backward_graph(heads, head_grads, variables,
+                                train_mode=train_mode)
+        return grads[0] if single else grads
     saved = [(getattr(v, "_grad", None), getattr(v, "_grad_req", "null"),
               getattr(v, "_require_grad", False)) for v in variables]
     for v in variables:

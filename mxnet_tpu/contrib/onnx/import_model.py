@@ -53,10 +53,27 @@ def _tensor_to_np(t):
     """TensorProto-shaped -> numpy."""
     if hasattr(t, "raw_data") and getattr(t, "raw_data", b""):
         # decode locally — onnx.numpy_helper would reject the vendored
-        # subset's message class anyway (different descriptor type)
-        dt = {1: np.float32, 6: np.int32, 7: np.int64,
-              11: np.float64}.get(getattr(t, "data_type", 1), np.float32)
-        return np.frombuffer(t.raw_data, dt).reshape(tuple(t.dims))
+        # subset's message class anyway (different descriptor type).
+        # TensorProto.DataType enum values from the ONNX IR spec.
+        _DT = {1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16,
+               5: np.int16, 6: np.int32, 7: np.int64, 9: np.bool_,
+               10: np.float16, 11: np.float64, 12: np.uint32,
+               13: np.uint64}
+        code = getattr(t, "data_type", 1)
+        if code == 16:  # bfloat16: numpy via ml_dtypes (jax dependency)
+            import ml_dtypes
+            return np.frombuffer(
+                t.raw_data, ml_dtypes.bfloat16).reshape(tuple(t.dims))
+        if code not in _DT:  # e.g. 8=string: no numpy dtype
+            try:  # a real TensorProto may still decode via onnx itself
+                from onnx import numpy_helper
+                return numpy_helper.to_array(t)
+            except Exception:
+                pass
+            raise NotImplementedError(
+                f"ONNX tensor {getattr(t, 'name', '?')!r}: data_type "
+                f"{code} raw_data is not supported")
+        return np.frombuffer(t.raw_data, _DT[code]).reshape(tuple(t.dims))
     for field, dt in (("float_data", np.float32), ("int64_data", np.int64),
                       ("int32_data", np.int32), ("double_data", np.float64)):
         data = list(getattr(t, field, ()) or ())
@@ -256,11 +273,12 @@ def import_model(model_file):
     falls back to the ``onnx`` package if it is installed and the subset
     schema ever falls short."""
     graph = None
+    with open(model_file, "rb") as f:  # OSError (bad path) propagates
+        raw = f.read()
     try:
         from .proto import onnx_subset_pb2 as P
         model = P.ModelProto()
-        with open(model_file, "rb") as f:
-            model.ParseFromString(f.read())
+        model.ParseFromString(raw)
         if model.graph.node:
             graph = model.graph
     except Exception:

@@ -531,7 +531,7 @@ class HybridBlock(Block):
             out_nds = [_wrap(o) for o in all_out[:n_main]]
             write_nds = [_wrap(o) for o in all_out[n_main:]]
             node = autograd.TapeNode(vjp_fn, param_list + nd_args,
-                                     len(all_out), self.name)
+                                     len(all_out), self.name, fn=closed)
             for i, o in enumerate(out_nds + write_nds):
                 o._node = node
                 o._node_index = i

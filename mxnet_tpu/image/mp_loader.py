@@ -1,0 +1,382 @@
+"""Multiprocess RecordIO image pipeline.
+
+TPU-native analog of the reference's multithreaded decode+augment iterator
+(reference: src/io/iter_image_recordio_2.cc:727 — ImageRecordIOParser2
+decodes JPEGs on an OpenCV thread pool into pinned batch buffers). Python
+threads can't parallelize cv2.imdecode-bound work past the GIL for the
+numpy glue around it, so the TPU rebuild uses worker *processes* feeding
+preallocated shared-memory batch slots:
+
+    parent: ring of K shared-memory slots ──▶ NDArray batches
+    worker[i]: owns 1/N of the record index; loop:
+        take free slot → read+decode+augment a full batch into it → ready
+
+Each worker builds whole batches from its own index shard (the same
+record-sharding the reference applies across its decode threads and across
+``num_parts`` distributed workers), so no cross-process assembly is needed
+and a slot is written by exactly one process at a time.
+
+Epoch semantics: every epoch each worker reshuffles its shard with
+seed=(seed, epoch) when ``shuffle``; the parent raises StopIteration after
+the fixed per-epoch batch count. Partial per-shard tail batches are padded
+by wraparound with the pad count reported on ``DataBatch.pad`` (the
+reference's round_batch behavior) so metrics can ignore padded records and
+no record is silently dropped.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+from multiprocessing import shared_memory
+
+import numpy as np
+
+from .. import recordio
+from ..io import DataBatch, DataDesc, DataIter
+
+__all__ = ["MPImageRecordIter"]
+
+
+def _fast_augment(img, out_hw, rand_crop, rand_mirror, resize, rng,
+                  interp):
+    """numpy/cv2 augment fast path: resize-shorter-side, crop, mirror.
+    Matches CreateAugmenter(resize, rand_crop, rand_mirror) semantics
+    (reference: image.py:877) without per-op NDArray round trips."""
+    import cv2
+    h, w = img.shape[:2]
+    oh, ow = out_hw
+    if resize:
+        # resize shorter side to `resize`, keep aspect
+        if h < w:
+            nh, nw = resize, max(ow, int(w * resize / h))
+        else:
+            nh, nw = max(oh, int(h * resize / w)), resize
+        img = cv2.resize(img, (nw, nh), interpolation=interp)
+        h, w = nh, nw
+    if h < oh or w < ow:
+        img = cv2.resize(img, (max(w, ow), max(h, oh)),
+                         interpolation=interp)
+        h, w = img.shape[:2]
+    if rand_crop:
+        y0 = rng.randint(0, h - oh + 1)
+        x0 = rng.randint(0, w - ow + 1)
+    else:
+        y0, x0 = (h - oh) // 2, (w - ow) // 2
+    img = img[y0:y0 + oh, x0:x0 + ow]
+    if rand_mirror and rng.randint(2):
+        img = img[:, ::-1]
+    return img
+
+
+def _worker(rank, path_imgrec, path_imgidx, keys, batch_size, data_shape,
+            label_width, shuffle, seed, rand_crop, rand_mirror, resize,
+            mean, std, out_dtype, shm_name, lbl_shm_name, nslots,
+            free_q, ready_q, interp):
+    """Worker main: decode+augment its shard into shared-memory slots."""
+    # never let a stray jax use in a child grab the TPU the parent owns
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import cv2
+    cv2.setNumThreads(0)  # one process = one core; don't oversubscribe
+    c, oh, ow = data_shape
+    rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+    shm = shared_memory.SharedMemory(name=shm_name)
+    lbl_shm = shared_memory.SharedMemory(name=lbl_shm_name)
+    slot_shape = (nslots, batch_size, c, oh, ow)
+    data_buf = np.ndarray(slot_shape, dtype=out_dtype, buffer=shm.buf)
+    lbl_buf = np.ndarray((nslots, batch_size, label_width), np.float32,
+                         buffer=lbl_shm.buf)
+    normalize = out_dtype != np.uint8 and (mean is not None
+                                           or std is not None)
+    mean_a = None if mean is None else np.asarray(
+        mean, np.float32).reshape(1, 1, -1)
+    std_a = None if std is None else np.asarray(
+        std, np.float32).reshape(1, 1, -1)
+    keys = np.asarray(keys)
+    # tail batch wraps around the shard and reports pad, like the
+    # reference's round_batch behavior (iter_image_recordio_2.cc) — padded
+    # records are ignored by metrics via DataBatch.pad
+    nbatch = -(-len(keys) // batch_size)
+    epoch = 0
+    try:
+        while True:
+            order = keys.copy()
+            if shuffle:
+                np.random.RandomState((seed, rank, epoch)).shuffle(order)
+            rng = np.random.RandomState((seed + 1, rank, epoch))
+            for b in range(nbatch):
+                slot = free_q.get()
+                if slot is None:
+                    return
+                idxs = order[b * batch_size:(b + 1) * batch_size]
+                pad = batch_size - len(idxs)
+                if pad:
+                    idxs = np.concatenate([idxs, order[:pad]])
+                for i, k in enumerate(idxs):
+                    header, raw = recordio.unpack(rec.read_idx(int(k)))
+                    img = cv2.imdecode(np.frombuffer(raw, np.uint8),
+                                       cv2.IMREAD_COLOR)
+                    if img is None:
+                        raise ValueError(
+                            f"cannot decode image record {int(k)} in "
+                            f"{path_imgrec}")
+                    img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+                    img = _fast_augment(img, (oh, ow), rand_crop,
+                                        rand_mirror, resize, rng, interp)
+                    if normalize:
+                        img = img.astype(np.float32)
+                        if mean_a is not None:
+                            img = img - mean_a
+                        if std_a is not None:
+                            img = img / std_a
+                    # HWC -> CHW into the slot (dtype cast happens here)
+                    data_buf[slot, i] = img.transpose(2, 0, 1)
+                    lab = np.atleast_1d(np.asarray(header.label,
+                                                   np.float32))
+                    lbl_buf[slot, i] = lab[:label_width]
+                ready_q.put(("ok", rank, slot, epoch, pad))
+            epoch += 1
+    except (KeyboardInterrupt, EOFError, BrokenPipeError):
+        pass
+    except Exception as e:  # surface the failure instead of hanging the job
+        import traceback
+        traceback.print_exc()
+        try:
+            ready_q.put(("error", rank, f"{type(e).__name__}: {e}"))
+        except Exception:
+            pass
+    finally:
+        shm.close()
+        lbl_shm.close()
+
+
+class MPImageRecordIter(DataIter):
+    """Multiprocess ImageRecordIter (see module docstring).
+
+    Parameters mirror ``io.ImageRecordIter``; ``preprocess_threads`` is the
+    worker *process* count (the reference's arg drives its decode thread
+    pool: src/io/iter_image_recordio_2.cc:727).
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 preprocess_threads=4, prefetch_buffer=2, shuffle=False,
+                 seed=0, rand_crop=False, rand_mirror=False, resize=0,
+                 mean=None, std=None, dtype="float32", num_parts=1,
+                 part_index=0, data_name="data",
+                 label_name="softmax_label", path_imgidx=None,
+                 inter_method=1, as_numpy=False):
+        super().__init__(batch_size)
+        if path_imgidx is None:
+            path_imgidx = os.path.splitext(path_imgrec)[0] + ".idx"
+        if not os.path.isfile(path_imgidx):
+            raise IOError(
+                f"MPImageRecordIter needs an index file ({path_imgidx}); "
+                "build one with tools/im2rec.py")
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.data_name = data_name
+        self.label_name = label_name
+        self._as_numpy = as_numpy
+        self._dtype = np.dtype(dtype)
+        nworkers = max(1, int(preprocess_threads))
+
+        idx_rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+        keys = list(idx_rec.keys)
+        idx_rec.close()
+        if num_parts > 1:
+            # round-robin partition: disjoint, and no remainder records
+            # are dropped (contiguous-slice partitioning loses up to
+            # num_parts-1 records every epoch)
+            keys = keys[part_index::num_parts]
+        if self._dtype == np.uint8 and (mean is not None
+                                        or std is not None):
+            raise ValueError(
+                "dtype='uint8' cannot carry mean/std normalization "
+                "(fold it into an on-device preprocess, or use "
+                "dtype='float32')")
+        nworkers = min(nworkers, max(1, len(keys) // batch_size))
+        shards = [keys[r::nworkers] for r in range(nworkers)]
+        # fixed epoch length: per-shard batch counts, tail batches padded
+        # by wraparound (reference round_batch semantics)
+        self._batches_per_epoch = sum(
+            -(-len(s) // batch_size) for s in shards)
+        if self._batches_per_epoch == 0:
+            raise ValueError(
+                f"dataset too small: {len(keys)} records, "
+                f"batch {batch_size} x {nworkers} workers")
+
+        c, h, w = self.data_shape
+        # each worker owns a private pool of slots so one fast worker can't
+        # hoard the ring and run ahead while another still owes batches for
+        # the current epoch (the parent re-orders cross-epoch arrivals via
+        # the epoch tag; private pools bound each worker's run-ahead, which
+        # also makes the epoch-stash deadlock-free)
+        per_worker = max(2, 1 + int(prefetch_buffer))
+        nslots = nworkers * per_worker
+        itemsize = self._dtype.itemsize
+        self._shm = shared_memory.SharedMemory(
+            create=True, size=nslots * batch_size * c * h * w * itemsize)
+        self._lbl_shm = shared_memory.SharedMemory(
+            create=True, size=nslots * batch_size * label_width * 4)
+        self._data_view = np.ndarray(
+            (nslots, batch_size, c, h, w), self._dtype, buffer=self._shm.buf)
+        self._lbl_view = np.ndarray(
+            (nslots, batch_size, label_width), np.float32,
+            buffer=self._lbl_shm.buf)
+
+        # forkserver: children fork from a clean server process — no
+        # re-import of __main__ (spawn breaks under REPL/stdin scripts)
+        # and no unsafe fork of the jax-initialized parent. The preload
+        # makes the server import this module once so each worker forks
+        # ready-to-run instead of paying the package import.
+        try:
+            ctx = mp.get_context("forkserver")
+            mp.set_forkserver_preload(["mxnet_tpu.image.mp_loader"])
+        except (ValueError, AttributeError):  # non-POSIX fallback
+            ctx = mp.get_context("spawn")
+        self._free_qs = [ctx.Queue() for _ in range(nworkers)]
+        self._ready_q = ctx.Queue()
+        for r in range(nworkers):
+            for s in range(r * per_worker, (r + 1) * per_worker):
+                self._free_qs[r].put(s)
+        self._procs = []
+        # multiprocessing's child bootstrap re-imports __main__ from its
+        # __file__; for stdin/REPL sessions that "file" is '<stdin>' and
+        # the child crashes before reaching the worker. Hide a non-file
+        # __main__.__file__ for the duration of process start so the
+        # bootstrap skips the main-module fixup.
+        import sys as _sys
+        main_mod = _sys.modules.get("__main__")
+        saved_file = getattr(main_mod, "__file__", None)
+        hide = saved_file is not None and not os.path.isfile(saved_file)
+        if hide:
+            del main_mod.__file__
+        try:
+            for r in range(nworkers):
+                p = ctx.Process(
+                    target=_worker,
+                    args=(r, path_imgrec, path_imgidx, shards[r],
+                          batch_size, self.data_shape, label_width,
+                          shuffle, seed, rand_crop, rand_mirror, resize,
+                          mean, std, self._dtype, self._shm.name,
+                          self._lbl_shm.name, nslots, self._free_qs[r],
+                          self._ready_q, inter_method),
+                    daemon=True)
+                p.start()
+                self._procs.append(p)
+        finally:
+            if hide:
+                main_mod.__file__ = saved_file
+        self._cursor = 0
+        self._epoch = 0
+        self._pending = {}  # epoch -> [(rank, slot), ...] arrived early
+        self._closed = False
+        # weakref-based: lets un-closed iterators be garbage collected
+        # (an atexit.register(self.close) would pin self alive forever)
+        import weakref
+        self._finalizer = weakref.finalize(
+            self, _shutdown, self._procs, self._free_qs,
+            (self._shm, self._lbl_shm))
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape,
+                         self._dtype)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        """Start the next epoch. At a normal epoch boundary this is free;
+        mid-epoch it SKIPS the remainder (stale batches are discarded as
+        they arrive — no blocking on decode work), and before anything was
+        consumed it is a no-op."""
+        if self._cursor == 0:
+            return
+        old = self._epoch
+        self._epoch += 1
+        self._cursor = 0
+        for (rank, slot, _pad) in self._pending.pop(old, []):
+            self._free_qs[rank].put(slot)
+
+    def _take_current_epoch(self):
+        """Next (rank, slot, pad) belonging to the parent's current epoch.
+        Later-epoch arrivals are stashed (bounded by each worker's private
+        slot pool); stale-epoch arrivals (after a mid-epoch reset) are
+        freed immediately; a dead or erroring worker raises instead of
+        hanging the job."""
+        import queue as _queue
+        stash = self._pending.get(self._epoch)
+        if stash:
+            return stash.pop(0)
+        while True:
+            try:
+                msg = self._ready_q.get(timeout=5.0)
+            except _queue.Empty:
+                dead = [r for r, p in enumerate(self._procs)
+                        if not p.is_alive()]
+                if dead:
+                    raise RuntimeError(
+                        f"data worker process(es) {dead} died "
+                        "unexpectedly; see stderr for the traceback")
+                continue
+            if msg[0] == "error":
+                raise RuntimeError(
+                    f"data worker {msg[1]} failed: {msg[2]}")
+            _, rank, slot, ep, pad = msg
+            if ep == self._epoch:
+                return rank, slot, pad
+            if ep < self._epoch:      # skipped by a mid-epoch reset
+                self._free_qs[rank].put(slot)
+                continue
+            self._pending.setdefault(ep, []).append((rank, slot, pad))
+
+    def next(self):
+        if self._cursor >= self._batches_per_epoch:
+            raise StopIteration
+        self._cursor += 1
+        rank, slot, pad = self._take_current_epoch()
+        data = np.array(self._data_view[slot], copy=True)
+        label = np.array(self._lbl_view[slot], copy=True)
+        self._free_qs[rank].put(slot)
+        if self.label_width == 1:
+            label = label[:, 0]
+        if self._as_numpy:
+            return DataBatch([data], [label], pad=pad)
+        from .. import ndarray as nd
+        return DataBatch([nd.array(data, dtype=str(self._dtype))],
+                         [nd.array(label)], pad=pad)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        del self._data_view, self._lbl_view
+        self._finalizer()  # stop workers + unlink shm (idempotent)
+        for shm in (self._shm, self._lbl_shm):
+            try:
+                shm.close()
+            except BufferError:
+                pass
+
+
+def _shutdown(procs, free_qs, shms):
+    """Finalizer for MPImageRecordIter (module-level: must not hold a
+    reference to the iterator, or it could never be collected)."""
+    for q in free_qs:
+        try:
+            q.put(None)
+        except Exception:
+            pass
+    for p in procs:
+        p.join(timeout=2)
+        if p.is_alive():
+            p.terminate()
+    for shm in shms:
+        try:
+            shm.unlink()
+        except Exception:
+            pass

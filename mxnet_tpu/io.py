@@ -436,10 +436,12 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
     """RecordIO image iterator with the reference's parameter surface
     (reference: src/io/iter_image_recordio_2.cc:727 ImageRecordIter).
 
-    Decode + augmentation run host-side in Python (the reference used an
-    OpenCV thread pool, so ``preprocess_threads`` is accepted for parity
-    but decode runs on the prefetch thread); ``prefetch_buffer=0`` disables
-    the background prefetch thread and returns the bare iterator.
+    ``preprocess_threads>0`` selects the multiprocess decode+augment
+    pipeline (``image.mp_loader.MPImageRecordIter`` — worker processes
+    filling shared-memory batch slots, the TPU rebuild of the reference's
+    OpenCV decode thread pool). ``preprocess_threads=0`` keeps the
+    single-process ``ImageIter`` path, wrapped in a prefetch thread unless
+    ``prefetch_buffer=0``.
     """
     mean = None
     if mean_r or mean_g or mean_b:
@@ -447,6 +449,43 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
     std = None
     if (std_r, std_g, std_b) != (1.0, 1.0, 1.0):
         std = np.array([std_r, std_g, std_b])
+    import os as _os
+    _idx = kwargs.get("path_imgidx") or \
+        _os.path.splitext(path_imgrec)[0] + ".idx"
+    _mp_keys = ("dtype", "seed", "path_imgidx", "inter_method",
+                "as_numpy")
+    _mp_unsupported = set(kwargs) - set(_mp_keys)
+    if preprocess_threads and _os.path.isfile(_idx) and not _mp_unsupported:
+        from .image.mp_loader import MPImageRecordIter
+        return MPImageRecordIter(
+            path_imgrec=path_imgrec, data_shape=data_shape,
+            batch_size=batch_size, label_width=label_width,
+            preprocess_threads=preprocess_threads,
+            prefetch_buffer=prefetch_buffer, shuffle=shuffle,
+            rand_crop=rand_crop, rand_mirror=rand_mirror, resize=resize,
+            mean=mean, std=std, num_parts=num_parts,
+            part_index=part_index, data_name=data_name,
+            label_name=label_name,
+            **{k: v for k, v in kwargs.items() if k in _mp_keys})
+    if preprocess_threads:
+        import warnings
+        # mp-only knobs have no ImageIter equivalent: strip them so they
+        # aren't silently swallowed, and say so
+        dropped = sorted(set(kwargs) & {"as_numpy", "seed"})
+        for k in dropped:
+            kwargs.pop(k)
+        extra = f"; dropping mp-only kwargs {dropped}" if dropped else ""
+        if _mp_unsupported:
+            warnings.warn(
+                "ImageRecordIter: kwargs "
+                f"{sorted(_mp_unsupported)} are not supported by the "
+                "multiprocess pipeline; falling back to the "
+                f"single-process path{extra}")
+        else:
+            warnings.warn(
+                f"ImageRecordIter: no index file at {_idx}; falling back "
+                "to the single-process pipeline (preprocess_threads needs "
+                f"a .idx — build one with tools/im2rec.py){extra}")
     from .image.image import ImageIter
     it = ImageIter(batch_size=batch_size, data_shape=data_shape,
                    label_width=label_width, path_imgrec=path_imgrec,

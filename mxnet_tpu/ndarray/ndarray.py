@@ -520,7 +520,7 @@ def _invoke_fn(name, fn, nd_inputs, n_out=1):
         outs, vjp_fn = jax.vjp(closed, *primals)
         out_nds = [_wrap(o) for o in outs]
         node = autograd.TapeNode(vjp_fn, [nd_inputs[i] for i in diff_idx],
-                                 len(out_nds), name)
+                                 len(out_nds), name, fn=closed)
         for i, o in enumerate(out_nds):
             o._node = node
             o._node_index = i
@@ -584,6 +584,17 @@ def _invoke_op_impl(name, nd_inputs, attrs):
     out = attrs.pop("out", None)
     if opdef.name in _TRAINING_AWARE_OPS:
         attrs.setdefault("training", autograd.is_training())
+    if opdef.name in _UNJITTED_OPS or (
+            opdef.name == "RNN" and attrs.get("p")
+            and attrs.get("training", True)):
+        # draw the RNG key HERE, once per call, and bind it into the op's
+        # attrs: the traced fn must be deterministic so that a
+        # create_graph=True replay (autograd._backward_graph re-runs
+        # node.fn under jax.vjp) reproduces the same dropout mask the
+        # forward used instead of silently resampling
+        if attrs.get("key") is None:
+            from .. import random as _random_mod
+            attrs["key"] = _random_mod.next_key()
     if opdef.no_grad:
         arrays = [x._data if isinstance(x, NDArray) else x for x in nd_inputs]
         res = opdef.fn(*arrays, **attrs)

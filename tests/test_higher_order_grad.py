@@ -1,0 +1,183 @@
+"""Higher-order autograd parity tests (VERDICT r3 weak #9).
+
+Reference semantics: autograd.grad(..., create_graph=True) records the
+backward pass into the graph so its results can be differentiated again
+(reference: python/mxnet/autograd.py:270 grad + create_graph flag into
+MXAutogradBackwardEx, src/imperative/imperative.cc:485; docstring example
+autograd.py:301-313). Here the backward replays each tape node's stored
+forward through jax.vjp as a recorded eager op (_backward_graph)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+
+
+def test_grad_of_grad_cubic():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x
+        gx = ag.grad(y, [x], create_graph=True, retain_graph=True)[0]
+    np.testing.assert_allclose(gx.asnumpy(), 3 * np.array([1, 4, 9.]),
+                               rtol=1e-6)
+    gx.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 6 * np.array([1, 2, 3.]),
+                               rtol=1e-6)
+
+
+def test_reference_docstring_example():
+    """The exact example from the reference grad() docstring
+    (autograd.py:301): z = exp(x) + x at x=1 -> dx = e+1, d2 = e."""
+    x = mx.nd.ones((1,))
+    x.attach_grad()
+    with ag.record():
+        z = mx.nd.exp(x) + x
+    dx = ag.grad(z, [x], create_graph=True)[0]
+    np.testing.assert_allclose(dx.asnumpy(), [np.e + 1], rtol=1e-6)
+    dx.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [np.e], rtol=1e-6)
+
+
+def test_first_order_grads_used_in_further_compute():
+    # z = sum(gx * x) with gx = 3x^2 recorded -> dz/dx = 9x^2
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x
+        gx = ag.grad(y, [x], create_graph=True, retain_graph=True)[0]
+        z = (gx * x).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 9 * np.array([1, 4, 9.]),
+                               rtol=1e-6)
+
+
+def test_third_order():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x * x
+        g1 = ag.grad(y, [x], create_graph=True, retain_graph=True)[0]
+        g2 = ag.grad(g1, [x], create_graph=True, retain_graph=True)[0]
+    g2.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [24.0 * 2], rtol=1e-6)
+
+
+def test_mixed_record_pause():
+    """Values computed under pause() are constants to the second-order
+    graph too (reference: autograd.pause stops recording, autograd.py:146)."""
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        with ag.pause():
+            c = x * x          # constant: not recorded
+        z = y * c              # dz/dx = 2x*c;  d2z/dx2 = 2c
+        gx = ag.grad(z, [x], create_graph=True, retain_graph=True)[0]
+    np.testing.assert_allclose(gx.asnumpy(), 2 * np.array([1, 8.]),
+                               rtol=1e-6)  # 2x^3
+    gx.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.array([1, 4.]),
+                               rtol=1e-6)  # 2x^2, NOT 6x^2
+
+
+def test_grad_returns_new_arrays_not_dot_grad():
+    """Reference: grads are 'returned as new NDArrays instead of stored
+    into variable.grad' (autograd.py:272)."""
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    before = x.grad.asnumpy().copy()
+    with ag.record():
+        y = x * x
+    g = ag.grad(y, [x], create_graph=True)[0]
+    np.testing.assert_allclose(g.asnumpy(), [6.0], rtol=1e-6)
+    np.testing.assert_array_equal(x.grad.asnumpy(), before)
+
+
+def test_head_grads_in_create_graph():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x
+    g = ag.grad(y, [x], head_grads=mx.nd.array([2.0, 0.5]),
+                create_graph=True)[0]
+    np.testing.assert_allclose(g.asnumpy(), [6.0, 6.0], rtol=1e-6)
+
+
+def test_create_graph_dropout_mask_consistent():
+    """The RNG key is drawn once per op CALL and bound into the traced fn,
+    so a create_graph replay reproduces the forward's dropout mask rather
+    than resampling (review finding r4)."""
+    mx.random.seed(7)
+    x = mx.nd.ones((64,))
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.Dropout(x, p=0.5)
+    mask = y.asnumpy()          # 0 or 2 (1/keep)
+    g = ag.grad(y, [x], create_graph=True)[0]
+    # d y / d x is exactly the forward's mask
+    np.testing.assert_array_equal(g.asnumpy(), mask)
+
+
+def test_second_order_matches_jax():
+    """Cross-check a composite expression against jax.grad-of-grad."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        return jnp.sum(jnp.tanh(x) * x ** 2)
+
+    xv = np.array([0.3, -1.2, 2.0], np.float32)
+    expect = jax.grad(lambda v: jnp.sum(jax.grad(f)(v)))(jnp.asarray(xv))
+
+    x = mx.nd.array(xv)
+    x.attach_grad()
+    with ag.record():
+        y = (mx.nd.tanh(x) * x * x).sum()
+        g1 = ag.grad(y, [x], create_graph=True, retain_graph=True)[0]
+    g1.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.asarray(expect),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_second_order_through_hybridized_block():
+    """Gradient-penalty style: grad of the squared grad-norm through a
+    hybridized Dense net (the fused-CachedOp tape node stores its forward,
+    so create_graph works through it)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="tanh"), nn.Dense(1))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+
+    x = mx.nd.array(np.random.RandomState(0).rand(4, 3).astype(np.float32))
+    x.attach_grad()
+    with ag.record():
+        out = net(x).sum()
+        gx = ag.grad(out, [x], create_graph=True, retain_graph=True)[0]
+        gp = (gx * gx).sum()
+    gp.backward()
+    got = x.grad.asnumpy()
+
+    # independent jax computation of d/dx ||df/dx||^2
+    params = {p.name: jnp.asarray(p.data().asnumpy())
+              for p in net.collect_params().values()}
+    w0 = [v for k, v in params.items() if "dense0_weight" in k][0]
+    b0 = [v for k, v in params.items() if "dense0_bias" in k][0]
+    w1 = [v for k, v in params.items() if "dense1_weight" in k][0]
+    b1 = [v for k, v in params.items() if "dense1_bias" in k][0]
+
+    def f(xa):
+        h = jnp.tanh(xa @ w0.T + b0)
+        return jnp.sum(h @ w1.T + b1)
+
+    def gp_fn(xa):
+        g = jax.grad(f)(xa)
+        return jnp.sum(g * g)
+
+    expect = jax.grad(gp_fn)(jnp.asarray(x.asnumpy()))
+    np.testing.assert_allclose(got, np.asarray(expect), rtol=1e-4,
+                               atol=1e-5)

@@ -1,0 +1,91 @@
+"""On-host input-pipeline benchmark: RecordIO -> JPEG decode -> augment ->
+batch, NO device involved.
+
+Answers VERDICT r3 "What's weak" #3: is the host pipeline fast enough to
+feed the chip? Builds a synthetic ImageNet-like .rec (480x360 JPEGs, the
+reference's standard resize for packed ImageNet), then measures images/sec
+through:
+
+  single    — ImageIter (single-process, the r3 path)
+  mp<N>     — MPImageRecordIter with N worker processes
+
+Usage: python tools/io_bench.py [n_images] [batch_size]
+Prints one JSON line per config.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def build_rec(tmp, n_images, w=480, h=360):
+    import cv2
+    import numpy as np
+    from mxnet_tpu import recordio
+
+    rec_path = os.path.join(tmp, "synth.rec")
+    idx_path = os.path.join(tmp, "synth.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    rng = np.random.RandomState(0)
+    # low-frequency images: realistic JPEG size (~30-60KB), unlike white
+    # noise which inflates decode cost
+    for i in range(n_images):
+        base = rng.randint(0, 256, (h // 8, w // 8, 3), np.uint8)
+        img = cv2.resize(base, (w, h), interpolation=cv2.INTER_CUBIC)
+        ok, buf = cv2.imencode(".jpg", img,
+                               [cv2.IMWRITE_JPEG_QUALITY, 90])
+        assert ok
+        header = recordio.IRHeader(0, float(i % 1000), i, 0)
+        rec.write_idx(i, recordio.pack(header, buf.tobytes()))
+    rec.close()
+    return rec_path
+
+
+def run(it, n_batches, batch_size, label=""):
+    it.reset()
+    # warm one batch (worker spin-up / file cache)
+    next(it)
+    t0 = time.perf_counter()
+    done = 0
+    while done < n_batches:
+        try:
+            next(it)
+            done += 1
+        except StopIteration:
+            it.reset()
+    dt = time.perf_counter() - t0
+    img_s = done * batch_size / dt
+    print(json.dumps({"pipeline": label, "img_s": round(img_s, 1),
+                      "batches": done, "batch_size": batch_size}),
+          flush=True)
+    return img_s
+
+
+def main():
+    n_images = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    import mxnet_tpu as mx
+
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = build_rec(tmp, n_images)
+        n_batches = max(4, n_images // batch - 2)
+        kw = dict(path_imgrec=rec, data_shape=(3, 224, 224),
+                  batch_size=batch, rand_crop=True, rand_mirror=True,
+                  shuffle=True)
+
+        it = mx.io.ImageRecordIter(preprocess_threads=0, prefetch_buffer=0,
+                                   **kw)
+        run(it, n_batches, batch, "single")
+
+        for n in (4, 8, 16):
+            it = mx.io.ImageRecordIter(preprocess_threads=n, dtype="uint8",
+                                       as_numpy=True, **kw)
+            run(it, n_batches, batch, f"mp{n}")
+            it.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,236 @@
+"""Layout/batch perf probe for the ResNet-50 training step on one TPU chip.
+
+Standalone raw-JAX mirror of the framework's fused TrainStep (fwd + bwd +
+SGD-momentum update + BN stat fold, params donated, bf16 compute over fp32
+master weights) used to decide which layout the framework should prefer:
+
+  A. NCHW  (the reference's layout; what the framework emits today)
+  B. NHWC  (TPU-native: channels on the 128-lane minor dimension)
+  C. NHWC + space-to-depth stem (the 7x7/s2 stem conv re-expressed on
+     4x4 space-to-depth-ed input so the MXU sees 48 input channels
+     instead of 3 — the standard MLPerf ResNet TPU trick)
+
+Each variant runs with FRESH random inputs per call (the r3 probe was
+invalidated by XLA CSE on reused inputs: VERDICT.md "What's weak" #2's
+note), async dispatch with one trailing sync, best-of-3.
+
+Usage: python tools/perf_probe.py [batch ...]
+Prints one JSON line per (variant, batch).
+"""
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------- model ----
+# (#blocks, channels) per stage for ResNet-50 v1 bottleneck
+STAGES = [(3, 256), (4, 512), (6, 1024), (3, 2048)]
+
+
+def _conv_init(key, cin, cout, k):
+    fan = cin * k * k
+    return (jax.random.normal(key, (k, k, cin, cout), jnp.float32)
+            * np.sqrt(2.0 / fan))
+
+
+def init_params(key, layout, s2d=False):
+    """Returns a flat list of (kind, array) params. kind in
+    {conv, gamma, beta, mean, var, dense_w, dense_b}."""
+    params = []
+    keys = iter(jax.random.split(key, 256))
+
+    def add_conv(cin, cout, k):
+        params.append(["conv", _conv_init(next(keys), cin, cout, k)])
+
+    def add_bn(c):
+        params.append(["gamma", jnp.ones((c,), jnp.float32)])
+        params.append(["beta", jnp.zeros((c,), jnp.float32)])
+        params.append(["mean", jnp.zeros((c,), jnp.float32)])
+        params.append(["var", jnp.ones((c,), jnp.float32)])
+
+    if s2d:
+        add_conv(3 * 16, 64, 2)   # 7x7/s2 on 4x4-s2d input ~= 2x2/s1 conv
+    else:
+        add_conv(3, 64, 7)
+    add_bn(64)
+    cin = 64
+    for nblk, cout in STAGES:
+        mid = cout // 4
+        for b in range(nblk):
+            add_conv(cin, mid, 1); add_bn(mid)
+            add_conv(mid, mid, 3); add_bn(mid)
+            add_conv(mid, cout, 1); add_bn(cout)
+            if b == 0:
+                add_conv(cin, cout, 1); add_bn(cout)  # downsample proj
+            cin = cout
+    params.append(["dense_w",
+                   jax.random.normal(next(keys), (2048, 1000), jnp.float32)
+                   * 0.01])
+    params.append(["dense_b", jnp.zeros((1000,), jnp.float32)])
+    return params
+
+
+def _conv(x, w, stride, layout):
+    # w is HWIO always; x layout varies
+    dn = (layout, "HWIO", layout)
+    pad = "SAME" if w.shape[0] > 1 else "VALID"
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), pad, dimension_numbers=dn)
+
+
+def forward(pvals, kinds, x, layout, s2d=False):
+    """Returns (logits, new_running_stats_list). BN in train mode: batch
+    stats normalize, running stats get momentum-folded (like the framework's
+    write_params fold)."""
+    caxis = 3 if layout == "NHWC" else 1
+    reduce_axes = tuple(i for i in range(4) if i != caxis)
+    it = iter(range(len(pvals)))
+    new_stats = []
+
+    def take():
+        return pvals[next(it)]
+
+    def bn_relu(x, relu=True):
+        g, b, m, v = take(), take(), take(), take()
+        mu = jnp.mean(x, reduce_axes)
+        var = jnp.var(x.astype(jnp.float32), reduce_axes).astype(x.dtype)
+        new_stats.append(0.9 * m + 0.1 * mu.astype(jnp.float32))
+        new_stats.append(0.9 * v + 0.1 * var.astype(jnp.float32))
+        shape = [1] * 4
+        shape[caxis] = -1
+        y = (x - mu.reshape(shape)) * (
+            g.reshape(shape) * jax.lax.rsqrt(var.reshape(shape) + 1e-5)) \
+            + b.reshape(shape)
+        return jax.nn.relu(y) if relu else y
+
+    # stem
+    if s2d:
+        x = _conv(x, take(), 1, layout)
+    else:
+        x = _conv(x, take(), 2, layout)
+    x = bn_relu(x)
+    if not s2d:
+        # 3x3/s2 maxpool
+        win = [1, 1, 1, 1]; win[1 if caxis == 3 else 2] = 3
+        win[2 if caxis == 3 else 3] = 3
+        st = [1, 1, 1, 1]; st[1 if caxis == 3 else 2] = 2
+        st[2 if caxis == 3 else 3] = 2
+        x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
+                                  tuple(win), tuple(st), "SAME")
+    cin = 64
+    for si, (nblk, cout) in enumerate(STAGES):
+        for b in range(nblk):
+            # stride on the 3x3 (v1.5 form; FLOP-comparable to v1 for timing)
+            stride = 2 if (b == 0 and si > 0) else 1
+            sc = x
+            y = _conv(x, take(), 1, layout); y = bn_relu(y)
+            y = _conv(y, take(), stride, layout); y = bn_relu(y)
+            y = _conv(y, take(), 1, layout); y = bn_relu(y, relu=False)
+            if b == 0:
+                sc = _conv(x, take(), stride, layout)
+                sc = bn_relu(sc, relu=False)
+            x = jax.nn.relu(y + sc)
+            cin = cout
+    x = jnp.mean(x, axis=(1, 2) if caxis == 3 else (2, 3))
+    w, b = take(), take()
+    return x @ w + b, new_stats
+
+
+def build_step(kinds, layout, s2d):
+    trainable = [k in ("conv", "gamma", "beta", "dense_w", "dense_b")
+                 for k in kinds]
+
+    def loss_fn(pv_train, pv_all, x, y):
+        pv = list(pv_all)
+        ti = 0
+        for i, t in enumerate(trainable):
+            if t:
+                pv[i] = pv_train[ti]; ti += 1
+        pv_c = [v.astype(jnp.bfloat16) for v in pv]
+        logits, stats = forward(pv_c, kinds, x.astype(jnp.bfloat16),
+                                layout, s2d)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        l = -jnp.mean(jnp.take_along_axis(logp, y[:, None], -1))
+        return l, stats
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(pvals, moms, x, y):
+        pv_train = [v for v, t in zip(pvals, trainable) if t]
+        (l, stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(pv_train, pvals, x, y)
+        new_p, new_m = list(pvals), list(moms)
+        ti = 0
+        for i, t in enumerate(trainable):
+            if t:
+                m = 0.9 * moms[ti] + grads[ti].astype(jnp.float32)
+                new_m[ti] = m
+                new_p[i] = pvals[i] - 0.1 * m
+                ti += 1
+        # fold running stats (they come back in traversal order)
+        si = 0
+        for i, k in enumerate(kinds):
+            if k in ("mean", "var"):
+                new_p[i] = stats[si]; si += 1
+        return new_p, new_m, l
+
+    return step, trainable
+
+
+def run_variant(name, layout, s2d, batch, steps=20):
+    dev = jax.devices()[0]
+    key = jax.random.PRNGKey(0)
+    params = init_params(key, layout, s2d)
+    kinds = [k for k, _ in params]
+    pvals = [jax.device_put(v, dev) for _, v in params]
+    step, trainable = build_step(kinds, layout, s2d)
+    moms = [jnp.zeros_like(v) for v, t in zip(pvals, trainable) if t]
+
+    if s2d:
+        shape = (batch, 56, 56, 48) if layout == "NHWC" \
+            else (batch, 48, 56, 56)
+    else:
+        shape = (batch, 224, 224, 3) if layout == "NHWC" \
+            else (batch, 3, 224, 224)
+    rng = np.random.RandomState(0)
+    n_host = 4
+    xs = [jax.device_put(
+        rng.rand(*shape).astype(np.float32), dev) for _ in range(n_host)]
+    ys = [jax.device_put(
+        rng.randint(0, 1000, (batch,)).astype(np.int32), dev)
+        for _ in range(n_host)]
+
+    # warmup/compile
+    pvals, moms, l = step(pvals, moms, xs[0], ys[0])
+    l.block_until_ready()
+
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            pvals, moms, l = step(pvals, moms, xs[i % n_host], ys[i % n_host])
+        l.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    step_t = best / steps
+    img_s = batch / step_t
+    model_flops = 3 * 4.089e9 * batch
+    mfu = model_flops / step_t / 197e12
+    print(json.dumps({"variant": name, "batch": batch,
+                      "step_s": round(step_t, 5),
+                      "img_s": round(img_s, 1),
+                      "model_mfu": round(mfu, 4)}), flush=True)
+    # free
+    del pvals, moms, xs, ys
+
+
+if __name__ == "__main__":
+    batches = [int(b) for b in sys.argv[1:]] or [256]
+    for b in batches:
+        run_variant("nchw", "NCHW", False, b)
+        run_variant("nhwc", "NHWC", False, b)
+        run_variant("nhwc_s2d", "NHWC", True, b)
