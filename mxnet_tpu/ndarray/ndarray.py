@@ -584,17 +584,21 @@ def _invoke_op_impl(name, nd_inputs, attrs):
     out = attrs.pop("out", None)
     if opdef.name in _TRAINING_AWARE_OPS:
         attrs.setdefault("training", autograd.is_training())
-    if opdef.name in _UNJITTED_OPS or (
-            opdef.name == "RNN" and attrs.get("p")
-            and attrs.get("training", True)):
+    _needs_rng = (
+        opdef.name == "Dropout"
+        and attrs.get("p", 0.5) > 0
+        and (attrs.get("training", True) or attrs.get("mode") == "always")
+    ) or (opdef.name == "RNN" and attrs.get("p")
+          and attrs.get("training", True))
+    if _needs_rng and attrs.get("key") is None:
         # draw the RNG key HERE, once per call, and bind it into the op's
         # attrs: the traced fn must be deterministic so that a
         # create_graph=True replay (autograd._backward_graph re-runs
         # node.fn under jax.vjp) reproduces the same dropout mask the
-        # forward used instead of silently resampling
-        if attrs.get("key") is None:
-            from .. import random as _random_mod
-            attrs["key"] = _random_mod.next_key()
+        # forward used instead of silently resampling. Identity cases
+        # (p=0, eval mode) must NOT touch the seeded stream.
+        from .. import random as _random_mod
+        attrs["key"] = _random_mod.next_key()
     if opdef.no_grad:
         arrays = [x._data if isinstance(x, NDArray) else x for x in nd_inputs]
         res = opdef.fn(*arrays, **attrs)

@@ -119,6 +119,34 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None
 # ---------------------------------------------------------------------------
 # Pooling (reference: src/operator/nn/pooling.cc)
 # ---------------------------------------------------------------------------
+@register_op("conv_s2d_stem", aliases=["_contrib_conv_s2d_stem"])
+def conv_s2d_stem(data, weight, **kw):
+    """Mathematically exact space-to-depth rewrite of the 7x7/s2/pad3
+    ImageNet stem conv: block-2 space-to-depth on the input, the SAME
+    (O,C,7,7) weights front-padded to 8x8 and folded to (O,C*4,4,4), then
+    a stride-1 conv with block-space pads (2,1). Identical output to
+    Convolution(kernel=7, stride=2, pad=3) for even H,W — checkpoint
+    compatible both directions (derivation: output pixel i reads
+    x[2i-3..2i+3]; splitting x into even/odd phases gives 4 block taps per
+    phase with the tap table w8[2a'+p] for the front-padded kernel).
+
+    Why: the MXU contracts over C*kh*kw; with C=3 the standard stem
+    wastes most of the 128-deep contraction lanes, and the folded form
+    quadruples the input-channel depth (the MLPerf ResNet TPU technique).
+    """
+    B, C, H, W = data.shape
+    O = weight.shape[0]
+    xs = data.reshape(B, C, H // 2, 2, W // 2, 2).transpose(
+        0, 1, 3, 5, 2, 4).reshape(B, C * 4, H // 2, W // 2)
+    w8 = jnp.pad(weight.astype(data.dtype),
+                 ((0, 0), (0, 0), (1, 0), (1, 0)))
+    wf = w8.reshape(O, C, 4, 2, 4, 2).transpose(
+        0, 1, 3, 5, 2, 4).reshape(O, C * 4, 4, 4)
+    return jax.lax.conv_general_dilated(
+        xs, wf, (1, 1), ((2, 1), (2, 1)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW")).astype(data.dtype)
+
+
 @register_op("Pooling")
 def pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
             pad=None, pooling_convention="valid", cudnn_off=False,

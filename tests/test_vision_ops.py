@@ -336,3 +336,44 @@ class TestPSROIPooling:
                                    group_size=2).sum()
         loss.backward()
         assert float(np.abs(data.grad.asnumpy()).sum()) > 0
+
+
+class TestConvS2DStem:
+    """conv_s2d_stem must be bit-level-close to Convolution(7,2,3) — it is
+    the MLPerf space-to-depth stem rewrite with identical weight storage
+    (ops/nn.py conv_s2d_stem)."""
+
+    def test_matches_standard_stem(self):
+        import mxnet_tpu as mx
+        from mxnet_tpu import nd
+        rng = np.random.RandomState(3)
+        x = nd.array(rng.rand(2, 3, 64, 64).astype(np.float32))
+        w = nd.array(rng.rand(8, 3, 7, 7).astype(np.float32))
+        ref = nd.Convolution(x, w, kernel=(7, 7), stride=(2, 2),
+                             pad=(3, 3), num_filter=8, no_bias=True)
+        out = nd.conv_s2d_stem(x, w)
+        np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_gradients_match(self):
+        from mxnet_tpu import nd, autograd as ag
+        rng = np.random.RandomState(4)
+        xv = rng.rand(1, 3, 32, 32).astype(np.float32)
+        wv = rng.rand(4, 3, 7, 7).astype(np.float32)
+        grads = []
+        for op in ("std", "s2d"):
+            x, w = nd.array(xv), nd.array(wv)
+            x.attach_grad(); w.attach_grad()
+            with ag.record():
+                if op == "std":
+                    y = nd.Convolution(x, w, kernel=(7, 7), stride=(2, 2),
+                                       pad=(3, 3), num_filter=4,
+                                       no_bias=True)
+                else:
+                    y = nd.conv_s2d_stem(x, w)
+                y.sum().backward()
+            grads.append((x.grad.asnumpy(), w.grad.asnumpy()))
+        np.testing.assert_allclose(grads[0][0], grads[1][0],
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(grads[0][1], grads[1][1],
+                                   rtol=1e-4, atol=1e-4)

@@ -54,10 +54,10 @@ def init_params(key, layout, s2d=False):
         params.append(["mean", jnp.zeros((c,), jnp.float32)])
         params.append(["var", jnp.ones((c,), jnp.float32)])
 
-    if s2d:
+    if s2d and s2d != "exact":
         add_conv(3 * 16, 64, 2)   # 7x7/s2 on 4x4-s2d input ~= 2x2/s1 conv
     else:
-        add_conv(3, 64, 7)
+        add_conv(3, 64, 7)        # 'exact' folds the 7x7 at run time
     add_bn(64)
     cin = 64
     for nblk, cout in STAGES:
@@ -110,12 +110,30 @@ def forward(pvals, kinds, x, layout, s2d=False):
         return jax.nn.relu(y) if relu else y
 
     # stem
-    if s2d:
+    if s2d == "exact":
+        # mathematically exact fold of the 7x7/s2 stem: block-2
+        # space-to-depth input + end-padded kernel folded to 4x4/s1
+        # (verified equal to the reference stem; see model_zoo resnet)
+        w = take()  # HWIO (7,7,3,64) weights — identical storage
+        assert layout == "NCHW"
+        B, C, H, W = x.shape
+        xs = x.reshape(B, C, H // 2, 2, W // 2, 2).transpose(
+            0, 1, 3, 5, 2, 4).reshape(B, C * 4, H // 2, W // 2)
+        # same fold as the tested ops/nn.py conv_s2d_stem: FRONT-padded
+        # kernel + block-space pads (2,1) == Convolution(7,2,pad=3)
+        w = w.transpose(3, 2, 0, 1)  # -> OIHW (64,3,7,7)
+        w8 = jnp.pad(w, ((0, 0), (0, 0), (1, 0), (1, 0)))
+        wf = w8.reshape(64, C, 4, 2, 4, 2).transpose(
+            0, 1, 3, 5, 2, 4).reshape(64, C * 4, 4, 4)
+        x = jax.lax.conv_general_dilated(
+            xs, wf, (1, 1), ((2, 1), (2, 1)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    elif s2d:
         x = _conv(x, take(), 1, layout)
     else:
         x = _conv(x, take(), 2, layout)
     x = bn_relu(x)
-    if not s2d:
+    if s2d != True:  # noqa: E712 — 'exact' keeps the reference maxpool
         # 3x3/s2 maxpool
         win = [1, 1, 1, 1]; win[1 if caxis == 3 else 2] = 3
         win[2 if caxis == 3 else 3] = 3
@@ -191,7 +209,7 @@ def run_variant(name, layout, s2d, batch, steps=20):
     step, trainable = build_step(kinds, layout, s2d)
     moms = [jnp.zeros_like(v) for v, t in zip(pvals, trainable) if t]
 
-    if s2d:
+    if s2d and s2d != "exact":
         shape = (batch, 56, 56, 48) if layout == "NHWC" \
             else (batch, 48, 56, 56)
     else:
@@ -228,9 +246,18 @@ def run_variant(name, layout, s2d, batch, steps=20):
     del pvals, moms, xs, ys
 
 
+VARIANTS = {
+    "nchw": ("NCHW", False),
+    "nhwc": ("NHWC", False),
+    "nhwc_s2d": ("NHWC", True),
+    "nchw_s2d_exact": ("NCHW", "exact"),
+}
+
 if __name__ == "__main__":
-    batches = [int(b) for b in sys.argv[1:]] or [256]
+    names = [a for a in sys.argv[1:] if not a.isdigit()] or \
+        ["nchw", "nhwc", "nhwc_s2d"]
+    batches = [int(a) for a in sys.argv[1:] if a.isdigit()] or [256]
     for b in batches:
-        run_variant("nchw", "NCHW", False, b)
-        run_variant("nhwc", "NHWC", False, b)
-        run_variant("nhwc_s2d", "NHWC", True, b)
+        for n in names:
+            layout, s2d = VARIANTS[n]
+            run_variant(n, layout, s2d, b)
