@@ -196,16 +196,7 @@ def main():
                 preprocess_threads=max(2, min(8, host_cores)),
                 dtype="uint8", as_numpy=True, rand_crop=True,
                 rand_mirror=True, shuffle=True)
-            it.reset(); next(it)  # warm: worker spin-up
-            t0 = time.perf_counter()
-            nb = 0
-            for _ in range(8):
-                try:
-                    next(it)
-                    nb += 1
-                except StopIteration:
-                    it.reset()
-            host_decode = nb * 128 / (time.perf_counter() - t0)
+            host_decode = io_bench.run(it, 8, 128, quiet=True)
             it.close()
     except Exception:
         pass

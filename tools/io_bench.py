@@ -44,7 +44,7 @@ def build_rec(tmp, n_images, w=480, h=360):
     return rec_path
 
 
-def run(it, n_batches, batch_size, label=""):
+def run(it, n_batches, batch_size, label="", quiet=False):
     it.reset()
     # warm one batch (worker spin-up / file cache)
     next(it)
@@ -58,9 +58,10 @@ def run(it, n_batches, batch_size, label=""):
             it.reset()
     dt = time.perf_counter() - t0
     img_s = done * batch_size / dt
-    print(json.dumps({"pipeline": label, "img_s": round(img_s, 1),
-                      "batches": done, "batch_size": batch_size}),
-          flush=True)
+    if not quiet:
+        print(json.dumps({"pipeline": label, "img_s": round(img_s, 1),
+                          "batches": done, "batch_size": batch_size}),
+              flush=True)
     return img_s
 
 
