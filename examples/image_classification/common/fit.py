@@ -203,6 +203,15 @@ def fit(args, network, data_loader, **kwargs):
     sym, arg_params, aux_params = _load_model(args, kv.rank)
     if sym is not None:
         assert sym.tojson() == network.tojson()
+    # caller-provided warm-start params (fine_tune.py) take precedence
+    # over checkpoint loading; both can't be active at once. Always pop:
+    # leftovers would collide with the explicit keywords at model.fit.
+    caller_arg = kwargs.pop("arg_params", None)
+    caller_aux = kwargs.pop("aux_params", None)
+    if caller_arg is not None or caller_aux is not None:
+        assert arg_params is None and aux_params is None, \
+            "pass either --load-epoch or explicit arg/aux_params, not both"
+        arg_params, aux_params = caller_arg, caller_aux
 
     checkpoint = _save_model(args, kv.rank)
 

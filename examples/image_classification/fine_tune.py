@@ -1,0 +1,66 @@
+"""Fine-tune a pretrained checkpoint on a new dataset — the analog of the
+reference's example/image-classification/fine-tune.py.
+
+Replaces the classifier head (everything after --layer-before-fullc) with a
+fresh FC for the new class count, then trains with the standard fit driver;
+backbone weights come from the checkpoint (convert reference checkpoints
+with tools/convert_params.py first if needed).
+
+    python fine_tune.py --pretrained-model ckpt/r50 --load-epoch 90 \\
+        --data-train caltech_train.rec --num-classes 256 \\
+        --num-examples 15240
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from common import data, fit
+
+import mxnet_tpu as mx
+
+
+def get_fine_tune_model(symbol, arg_params, num_classes,
+                        layer_name="flatten0"):
+    """Cut the graph after ``layer_name`` and attach a fresh classifier
+    (reference: fine-tune.py get_fine_tune_model)."""
+    all_layers = symbol.get_internals()
+    net = all_layers[layer_name + "_output"]
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc_new")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    new_args = {k: v for k, v in arg_params.items()
+                if not k.startswith("fc_new")}
+    return net, new_args
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="fine-tune a pretrained model",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    data.add_aug_args(parser)
+    parser.add_argument("--pretrained-model", required=True,
+                        help="checkpoint prefix to start from")
+    parser.add_argument("--layer-before-fullc", default="flatten0",
+                        help="name of the layer before the classifier")
+    parser.set_defaults(
+        network=None, image_shape="3,224,224", num_epochs=30,
+        lr=0.01, lr_step_epochs="20", wd=1e-4, batch_size=128)
+    args = parser.parse_args()
+
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.pretrained_model, args.load_epoch or 0)
+    net, new_args = get_fine_tune_model(
+        sym, arg_params, args.num_classes, args.layer_before_fullc)
+    # fit must not try to reload the checkpoint on top of the edited graph
+    args.load_epoch = None
+    fit.fit(args, net, data.get_rec_iter,
+            arg_params=new_args, aux_params=aux_params)
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    main()

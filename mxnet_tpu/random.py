@@ -10,11 +10,13 @@ from __future__ import annotations
 import threading
 
 import jax
+import numpy as np
 
 __all__ = ["seed", "next_key", "current_key", "numpy_rng"]
 
 _lock = threading.Lock()
 _key = [None]  # lazy: creating a key at import time would init the backend
+_trace_fallback = [0]  # distinguishes next_key() calls inside one trace
 _np_rng = [None]  # host-side generator for initializers (reference seeds both)
 
 
@@ -60,13 +62,25 @@ def next_key():
         return sub
     with _lock:
         if _key[0] is None:
-            _key[0] = jax.random.PRNGKey(0)
-        _key[0], sub = jax.random.split(_key[0])
+            # host-side constant == jax.random.PRNGKey(0); constructing it
+            # via jax inside an ambient trace (stackless tracing traces
+            # ALL ops, even constant-input ones) would store a tracer
+            _key[0] = np.array([0, 0], np.uint32)
+        new, sub = jax.random.split(_key[0])
+        if isinstance(new, jax.core.Tracer):
+            # called under an unmanaged trace (e.g. eval_shape during
+            # Symbol.infer_shape over an RNG op): NEVER store a tracer
+            # into host RNG state — it would escape the trace and poison
+            # every later caller. A host-side counter (plain int, safe to
+            # advance) keeps successive calls inside one trace distinct.
+            _trace_fallback[0] += 1
+            return jax.random.fold_in(sub, _trace_fallback[0])
+        _key[0] = new
     return sub
 
 
 def current_key():
     with _lock:
         if _key[0] is None:
-            _key[0] = jax.random.PRNGKey(0)
+            _key[0] = np.array([0, 0], np.uint32)  # == PRNGKey(0)
         return _key[0]

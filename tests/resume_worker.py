@@ -19,6 +19,12 @@ sys.path.insert(0, os.path.join(_HERE, os.pardir))
 sys.path.insert(0, os.path.join(_HERE, os.pardir, "examples",
                                 "image_classification"))
 
+import jax  # noqa: E402
+
+# this is a CPU recovery test: pin the platform BEFORE mxnet_tpu import
+# (env JAX_PLATFORMS alone is clobbered by the axon sitecustomize)
+jax.config.update("jax_platforms", "cpu")
+
 import mxnet_tpu as mx  # noqa: E402
 
 

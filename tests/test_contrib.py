@@ -6,6 +6,7 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd
@@ -281,13 +282,19 @@ class TestOnnxImport:
         except NotImplementedError as e:
             assert "NonexistentOp" in str(e)
 
-    def test_import_model_requires_onnx_pkg(self):
+    def test_import_model_requires_onnx_pkg(self, tmp_path):
         from mxnet_tpu.contrib.onnx import import_model
+        # a bad path is a file error, not a masked onnx-package error
+        with pytest.raises(OSError):
+            import_model("/nonexistent.onnx")
         try:
             import onnx  # noqa: F401
         except ImportError:
+            # real file the vendored parser can't read -> needs onnx pkg
+            bad = tmp_path / "junk.onnx"
+            bad.write_bytes(b"\x00\x01 not a model")
             try:
-                import_model("/nonexistent.onnx")
+                import_model(str(bad))
                 assert False
             except ImportError as e:
                 assert "onnx" in str(e)

@@ -18,7 +18,12 @@ WORKER = os.path.join(os.path.dirname(__file__), "resume_worker.py")
 
 
 def _run(args):
-    env = dict(os.environ)
+    # force the CPU platform in the child: it inherits the raw env, and
+    # sitecustomize would otherwise point it at the real tunneled TPU
+    # (same strip as tests/test_dist.py)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["JAX_PLATFORMS"] = "cpu"
     return subprocess.run(
         [sys.executable, WORKER] + args,
         capture_output=True, text=True, env=env, timeout=600)

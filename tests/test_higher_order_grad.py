@@ -162,13 +162,13 @@ def test_second_order_through_hybridized_block():
     gp.backward()
     got = x.grad.asnumpy()
 
-    # independent jax computation of d/dx ||df/dx||^2
-    params = {p.name: jnp.asarray(p.data().asnumpy())
-              for p in net.collect_params().values()}
-    w0 = [v for k, v in params.items() if "dense0_weight" in k][0]
-    b0 = [v for k, v in params.items() if "dense0_bias" in k][0]
-    w1 = [v for k, v in params.items() if "dense1_weight" in k][0]
-    b1 = [v for k, v in params.items() if "dense1_bias" in k][0]
+    # independent jax computation of d/dx ||df/dx||^2 — read the layer
+    # params off the blocks directly (auto-generated NAMES shift when the
+    # full suite has created other dense blocks first)
+    w0 = jnp.asarray(net[0].weight.data().asnumpy())
+    b0 = jnp.asarray(net[0].bias.data().asnumpy())
+    w1 = jnp.asarray(net[1].weight.data().asnumpy())
+    b1 = jnp.asarray(net[1].bias.data().asnumpy())
 
     def f(xa):
         h = jnp.tanh(xa @ w0.T + b0)

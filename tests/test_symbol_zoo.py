@@ -1,0 +1,48 @@
+"""The example symbol families build, infer shapes, and produce the
+right feature dimensions (reference:
+example/image-classification/symbols/*.py)."""
+import os
+import sys
+
+import pytest
+
+EXAMPLE_DIR = os.path.join(os.path.dirname(__file__), "..", "examples",
+                           "image_classification")
+sys.path.insert(0, os.path.abspath(EXAMPLE_DIR))
+
+from symbols import alexnet, inception_v3, resnext, vgg  # noqa: E402
+
+
+@pytest.mark.parametrize("sym_fn,shape,classes", [
+    (lambda: alexnet.get_symbol(1000), (2, 3, 224, 224), 1000),
+    (lambda: vgg.get_symbol(1000, 16), (2, 3, 224, 224), 1000),
+    (lambda: vgg.get_symbol(10, 11), (2, 3, 224, 224), 10),
+    (lambda: inception_v3.get_symbol(1000), (2, 3, 299, 299), 1000),
+    (lambda: resnext.get_symbol(1000, 50), (2, 3, 224, 224), 1000),
+    (lambda: resnext.get_symbol(1000, 101), (2, 3, 224, 224), 1000),
+])
+def test_symbol_builds_and_infers(sym_fn, shape, classes):
+    sym = sym_fn()
+    arg_shapes, out_shapes, _ = sym.infer_shape(data=shape)
+    assert out_shapes[0] == (shape[0], classes)
+    # every argument got a concrete shape
+    assert all(s is not None for s in arg_shapes)
+
+
+def test_alexnet_tiny_forward():
+    """One real forward through the smallest new family."""
+    import numpy as np
+    import mxnet_tpu as mx
+    sym = alexnet.get_symbol(10)
+    mod = mx.mod.Module(symbol=sym, context=mx.cpu(),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (2, 3, 224, 224))],
+             label_shapes=[("softmax_label", (2,))], for_training=False)
+    mod.init_params(mx.init.Xavier())
+    b = mx.io.DataBatch(
+        [mx.nd.array(np.random.RandomState(0).rand(
+            2, 3, 224, 224).astype(np.float32))], [])
+    mod.forward(b, is_train=False)
+    out = mod.get_outputs()[0].asnumpy()
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)

@@ -26,9 +26,14 @@ import json
 import sys
 import time
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root, for mxnet_tpu.ops reuse
 
 # ---------------------------------------------------------------- model ----
 # (#blocks, channels) per stage for ResNet-50 v1 bottleneck
@@ -115,9 +120,6 @@ def forward(pvals, kinds, x, layout, stem="std"):
     if stem == "exact":
         # the tested exact fold from the framework op (identical math to
         # Convolution(7,2,pad=3)) — reuse it, don't re-derive
-        import os as _os
-        sys.path.insert(0, _os.path.join(_os.path.dirname(
-            _os.path.dirname(_os.path.abspath(__file__)))))
         from mxnet_tpu.ops.nn import conv_s2d_stem
         assert layout == "NCHW"
         w = take().transpose(3, 2, 0, 1)  # HWIO -> OIHW (64,3,7,7)
