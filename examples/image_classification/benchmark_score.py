@@ -29,8 +29,9 @@ def get_network(name):
     """Returns (symbol, image_shape)."""
     if name == "alexnet":
         return _alexnet.get_symbol(1000), (3, 224, 224)
-    if name == "vgg-16":
-        return _vgg.get_symbol(1000, 16), (3, 224, 224)
+    if name.startswith("vgg-"):
+        return _vgg.get_symbol(1000, int(name.split("-")[1])), \
+            (3, 224, 224)
     if name == "inception-v3":
         return _inc3.get_symbol(1000), (3, 299, 299)
     if name.startswith("resnext-"):

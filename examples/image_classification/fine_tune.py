@@ -30,8 +30,16 @@ def get_fine_tune_model(symbol, arg_params, num_classes,
     net = all_layers[layer_name + "_output"]
     net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc_new")
     net = mx.sym.SoftmaxOutput(net, name="softmax")
+    # keep only params the cut graph still uses: drops the old classifier
+    # head, and makes a wrong --layer-before-fullc fail loudly below
+    # instead of silently carrying orphaned weights
+    keep = set(net.list_arguments())
     new_args = {k: v for k, v in arg_params.items()
-                if not k.startswith("fc_new")}
+                if k in keep and not k.startswith("fc_new")}
+    if not new_args:
+        raise ValueError(
+            f"no checkpoint params survive the cut at {layer_name!r}; "
+            "check --layer-before-fullc")
     return net, new_args
 
 

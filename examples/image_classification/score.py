@@ -26,13 +26,19 @@ def score(model_prefix, load_epoch, data_val, image_shape=(3, 224, 224),
     mod.bind(data_shapes=val.provide_data,
              label_shapes=val.provide_label, for_training=False)
     mod.set_params(arg_params, aux_params)
-    metric_objs = [mx.metric.create(
-        m, top_k=int(m.rsplit("_", 1)[1]) if "top_k" in m else 1)
-        if "top_k" in m else mx.metric.create(m) for m in metrics]
-    for m in metric_objs:
-        mod.score(val, m)
-        val.reset()
-    return [(m.get()) for m in metric_objs]
+
+    def make_metric(m):
+        # "top_k_accuracy_5" -> top_k_accuracy with top_k=5
+        if m.startswith("top_k_accuracy"):
+            suffix = m[len("top_k_accuracy"):].lstrip("_")
+            return mx.metric.create("top_k_accuracy",
+                                    top_k=int(suffix) if suffix else 5)
+        return mx.metric.create(m)
+
+    composite = mx.metric.CompositeEvalMetric(
+        [make_metric(m) for m in metrics])
+    mod.score(val, composite)  # ONE inference pass for all metrics
+    return [m.get() for m in composite.metrics]
 
 
 if __name__ == "__main__":
