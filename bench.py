@@ -5,14 +5,18 @@ diagnostic fields (per-step times, MFU and the formula behind it).
 
 Baseline: the reference's published ResNet-50 training throughput of
 181.53 img/s on 1x P100 (docs/faq/perf.md:176-185, BASELINE.md) — the best
-single-accelerator number in the reference repo. This bench runs the same
-workload (1000-class training step, 224x224, bf16 compute) on one TPU chip
-through the fused TrainStep path, fed by a double-buffered host input
-pipeline (distinct batches; host->device transfer overlaps compute).
+single-accelerator number in the reference repo. This bench drives the
+NORTH-STAR path (BASELINE.json: train_imagenet.py): the symbolic resnet-50
+through the fused Module step — forward + backward + functional optimizer
+update + BatchNorm aux fold as one donated XLA program (module/fused.py) —
+in bf16, on one TPU chip. Measured ~6% faster than the gluon TrainStep
+path on the same chip (both remain available; tools/perf_probe.py has the
+sweep data).
 """
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -33,8 +37,7 @@ _PEAK_TFLOPS = {
 }
 
 # ResNet-50 @224x224: ~4.089 GFLOP forward per image (2*MACs); training
-# ~= 3x forward (fwd + 2x in bwd). Fallback when XLA cost analysis is
-# unavailable on the backend.
+# ~= 3x forward (fwd + 2x in bwd).
 RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.089e9
 
 
@@ -48,90 +51,71 @@ def _peak_flops(device) -> float:
 
 def main():
     import jax
-    import jax.numpy as jnp
     import mxnet_tpu as mx
-    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
-    from mxnet_tpu.parallel import TrainStep
 
-    # batch 128 beats 256 on v5e for this model (tools/perf_probe.py sweep:
-    # 2356 vs 2219 img/s — smaller working set, same MXU packing)
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "examples", "image_classification"))
+    from symbols import resnet as resnet_sym
+
+    # batch 128 beats 256 on v5e for this model (tools/perf_probe.py
+    # sweep: 2356 vs 2219 img/s — smaller working set, same MXU packing)
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
     steps = int(sys.argv[2]) if len(sys.argv) > 2 else 50
 
     mx.random.seed(0)
-    net = resnet50_v1(classes=1000)
-    net.initialize(mx.init.Xavier())
+    net = resnet_sym.get_symbol(1000, 50, "3,224,224")
+    model = mx.mod.Module(context=mx.gpu(0), symbol=net, fused=True,
+                          compute_dtype="bfloat16")
+    model.bind(data_shapes=[("data", (batch, 3, 224, 224))],
+               label_shapes=[("softmax_label", (batch,))])
+    model.init_params(mx.init.Xavier(rnd_type="gaussian",
+                                     factor_type="in", magnitude=2))
+    model.init_optimizer(kvstore=None, optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1,
+                                           "momentum": 0.9, "wd": 1e-4})
 
-    # the pipeline ships uint8 pixels and normalizes ON DEVICE inside the
-    # compiled step — 4x less host->device traffic than float32 (the
-    # reference's C++ iterator does mean-subtract host-side because PCIe
-    # to a 2016 GPU was fast relative to its FLOPs; on TPU the transfer is
-    # the scarce resource)
-    mean = jnp.asarray([123.68, 116.779, 103.939],
-                       jnp.bfloat16).reshape(1, 3, 1, 1)
-    scale = jnp.bfloat16(1.0 / 58.0)
-
-    def preprocess(u8):
-        return (u8.astype(jnp.bfloat16) - mean) * scale
-
-    step = TrainStep(net, loss="softmax_ce", optimizer="sgd",
-                     optimizer_params={"momentum": 0.9}, lr=0.1,
-                     compute_dtype="bfloat16", preprocess=preprocess)
-
-    # host input pipeline: distinct host batches cycled; the NEXT batch is
-    # staged to device while the current step computes (double buffering —
-    # the real path is ImageRecordIter -> PrefetchingIter -> device_put)
     rng = np.random.RandomState(0)
     n_host = 4
-    host_x = [rng.randint(0, 256, (batch, 3, 224, 224), dtype=np.uint8)
-              for _ in range(n_host)]
-    host_y = [rng.randint(0, 1000, (batch,)).astype(np.int32)
-              for _ in range(n_host)]
+    host_batches = [
+        mx.io.DataBatch(
+            [mx.nd.array(rng.rand(batch, 3, 224, 224).astype(np.float32))],
+            [mx.nd.array(rng.randint(0, 1000, (batch,)).astype(np.int32))])
+        for _ in range(n_host)]
     dev = jax.devices()[0]
 
-    def stage(i):
-        return (jax.device_put(host_x[i % n_host], dev),
-                jax.device_put(host_y[i % n_host], dev))
+    def run_step(b):
+        model.forward(b, is_train=True)
+        model.backward()
+        model.update()
 
-    # warmup / compile; the asnumpy is the process's first device->host
-    # transfer, which arms real blocking semantics for wait_to_read on
-    # the tunneled runtime (see benchmark_score.py)
-    xb, yb = stage(0)
+    # warmup / compile; block_until_ready on real state + one host fetch
+    # to arm blocking semantics on the tunneled runtime
     for _ in range(3):
-        loss = step(xb, yb)
-    float(loss.asnumpy())
+        run_step(host_batches[0])
+    np.asarray(jax.device_get(model._fused._pvals[0]))
+    jax.block_until_ready(model._fused._pvals)
 
     # -- phase A: steady-state compute throughput ---------------------------
-    # all n_host distinct batches live on device; the loop cycles them with
-    # no host work. This is the chip+framework number comparable to the
-    # reference's benchmark (its P100 read from local disk; here the chip
-    # is reached through a network tunnel, so per-step host->device
-    # transfer measures the tunnel, not the framework — reported
-    # separately in phase B).
-    staged = [stage(i) for i in range(n_host)]
-    # async dispatch, ONE sync at the end: each step's donated params make
-    # it depend on the previous one, so the runtime queues the whole run
-    # and host dispatch overlaps device compute (the reference's engine
-    # behaves the same way — ops are pushed, WaitToRead is the sync point)
-    # best of 3 full runs: the tunnel to the chip has bursty latency that
-    # can stall a whole run; the best run is the reproducible number
+    # all distinct batches already staged on device by the warmup of each;
+    # donated fused-step params chain the steps so one final block covers
+    # the whole run. Best of 3: the tunnel has bursty latency.
+    for b in host_batches:
+        run_step(b)          # stages every batch's device buffers
+    jax.block_until_ready(model._fused._pvals)
     dt = float("inf")
     for _ in range(3):
-        t_all0 = time.perf_counter()
-        loss = None
+        t0 = time.perf_counter()
         for i in range(steps):
-            xb, yb = staged[i % n_host]
-            loss = step(xb, yb)
-        loss.wait_to_read()
-        dt = min(dt, time.perf_counter() - t_all0)
+            run_step(host_batches[i % n_host])
+        jax.block_until_ready(model._fused._pvals)
+        dt = min(dt, time.perf_counter() - t0)
 
-    # per-step sync timing (diagnostic: includes one host->device dispatch
-    # round trip per step, which the async loop above hides)
+    # per-step sync timing (diagnostic: includes one dispatch round trip)
     sync_times = []
     for i in range(min(8, steps)):
-        xb, yb = staged[i % n_host]
         t0 = time.perf_counter()
-        step(xb, yb).wait_to_read()
+        run_step(host_batches[i % n_host])
+        jax.block_until_ready(model._fused._pvals)
         sync_times.append(time.perf_counter() - t0)
 
     img_s = batch * steps / dt
@@ -139,31 +123,40 @@ def main():
     min_step = float(np.min(sync_times))
 
     # -- phase B: double-buffered host input pipeline -----------------------
-    # next batch staged while the current step runs; measures end-to-end
-    # including the host->device link
+    # ship uint8 (4x less tunnel traffic), cast on device — the real
+    # pipeline's transfer strategy (ImageRecordIter dtype='uint8').
+    # Host batches are PRE-generated: the phase measures the transfer
+    # pipeline, not numpy's RNG.
     pipe_steps = max(5, steps // 3)
-    xb, yb = stage(0)
+    u8_batches = [rng.randint(0, 256, (batch, 3, 224, 224),
+                              dtype=np.uint8) for _ in range(n_host)]
+    y_batches = [rng.randint(0, 1000, (batch,)).astype(np.int32)
+                 for _ in range(n_host)]
     t_p0 = time.perf_counter()
     for i in range(pipe_steps):
-        loss = step(xb, yb)
-        if i + 1 < pipe_steps:
-            xb, yb = stage(i + 1)      # overlaps the in-flight step
-        loss.wait_to_read()
+        x = mx.nd.array(u8_batches[i % n_host],
+                        dtype="uint8").astype("float32")
+        y = mx.nd.array(y_batches[i % n_host])
+        run_step(mx.io.DataBatch([x], [y]))
+    jax.block_until_ready(model._fused._pvals)
     pipe_dt = time.perf_counter() - t_p0
     pipe_img_s = batch * pipe_steps / pipe_dt
 
     # -- MFU: model FLOPs per step / step time / chip bf16 peak --------------
-    # HEADLINE mfu uses the standard model-FLOPs convention (analytic
-    # 3 x 4.089 GFLOP/img for ResNet-50 training) so the number is
-    # comparable to published MFU figures. XLA's cost analysis of the
-    # compiled step (actual fwd+bwd+update FLOPs incl. padding/layout
-    # waste, ~1.8x higher) is reported separately as hardware utilization.
+    # HEADLINE mfu uses the standard model-FLOPs convention; XLA's cost
+    # analysis of the compiled fused step (actual fwd+bwd+update FLOPs
+    # incl. padding/layout waste) is reported as hardware utilization.
     model_flops_per_step = RESNET50_TRAIN_FLOPS_PER_IMG * batch
     xla_flops_per_step = None
     try:
-        lowered = step._step_jit.lower(
-            step._pvals, step._opt_state, xb, yb, step._t_dev,
-            jnp.asarray(0.1, jnp.float32))
+        fused = model._fused
+        b0 = host_batches[0]
+        name_to_val = {fused.data_names[0]: b0.data[0].data,
+                       fused.label_names[0]: b0.label[0].data}
+        feed = tuple(name_to_val[n] for n in fused.input_names)
+        lowered = fused._step_jit.lower(
+            fused._pvals, fused._opt_state, fused._aux_vals, feed,
+            fused._t_dev, fused._lr_cache[1])
         cost = lowered.compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}
@@ -179,21 +172,16 @@ def main():
                if peak and xla_flops_per_step else None)
 
     # -- phase C: on-host decode+augment pipeline (no device) ----------------
-    # the real input path: RecordIO -> JPEG decode -> crop/mirror -> batch,
-    # through the multiprocess shared-memory loader. Measured standalone so
-    # the number is a property of the host, not of the tunnel.
     host_decode = host_cores = None
     try:
-        import os
         import tempfile
         sys.path.insert(0, os.path.join(os.path.dirname(
             os.path.abspath(__file__)), "tools"))
         import io_bench
-        import mxnet_tpu as _mx
         host_cores = os.cpu_count()
         with tempfile.TemporaryDirectory() as tmp:
             rec = io_bench.build_rec(tmp, 768)
-            it = _mx.io.ImageRecordIter(
+            it = mx.io.ImageRecordIter(
                 path_imgrec=rec, data_shape=(3, 224, 224), batch_size=128,
                 preprocess_threads=max(2, min(8, host_cores)),
                 dtype="uint8", as_numpy=True, rand_crop=True,
@@ -213,6 +201,8 @@ def main():
         "step_time_s": round(mean_step, 5),
         "sync_step_min_s": round(min_step, 5),
         "device": getattr(dev, "device_kind", str(dev)),
+        "path": "Module(fused) symbolic graph + functional sgd, bf16 "
+                "(the BASELINE.json north-star train_imagenet path)",
         "mfu": round(mfu, 4),
         "mfu_formula": "model_flops / step_time / peak_bf16 "
                        f"[analytic 3x4.089 GFLOP/img; peak={peak/1e12:.0f}T]",

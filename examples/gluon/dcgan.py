@@ -2,11 +2,12 @@
 example/gluon/dcgan.py: 64x64 generator from Conv2DTranspose stacks, conv
 discriminator, alternating Trainer updates under autograd.
 
-With no dataset available the default --synthetic mode trains against
-low-frequency procedural images so the script runs end to end; point
---data at an image folder for real use.
+With no dataset available the default mode trains against low-frequency
+procedural images so the script runs end to end; point --data at a folder
+of jpg/png images for real use.
 
-  python dcgan.py --epochs 1 --batch-size 16 --synthetic
+  python dcgan.py --epochs 1 --batch-size 16
+  python dcgan.py --data /path/to/images --epochs 25
 """
 import argparse
 import logging
@@ -64,8 +65,29 @@ def synthetic_batches(batch_size, n):
         yield mx.nd.array(img)
 
 
+def folder_batches(path, batch_size, n):
+    """Batches from an image folder (resized/cropped to 64x64, [-1, 1])."""
+    import os
+    from mxnet_tpu import image as mx_image
+    files = sorted(
+        os.path.join(path, f) for f in os.listdir(path)
+        if f.lower().endswith((".jpg", ".jpeg", ".png")))
+    if not files:
+        raise ValueError(f"no images found under {path}")
+    i = 0
+    for _ in range(n):
+        imgs = []
+        while len(imgs) < batch_size:
+            arr = mx_image.imread(files[i % len(files)]).asnumpy()
+            i += 1
+            arr = np.asarray(mx_image.imresize(
+                mx.nd.array(arr), 64, 64).asnumpy(), np.float32)
+            imgs.append(arr.transpose(2, 0, 1) / 127.5 - 1.0)
+        yield mx.nd.array(np.stack(imgs))
+
+
 def train(epochs=1, batch_size=16, nz=100, lr=0.0002, beta1=0.5,
-          batches_per_epoch=20):
+          batches_per_epoch=20, data=None):
     gen = build_generator(nz=nz)
     disc = build_discriminator()
     gen.initialize(mx.init.Normal(0.02))
@@ -81,7 +103,10 @@ def train(epochs=1, batch_size=16, nz=100, lr=0.0002, beta1=0.5,
     d_loss = g_loss = None
     for epoch in range(epochs):
         tic = time.time()
-        for real in synthetic_batches(batch_size, batches_per_epoch):
+        batches = (folder_batches(data, batch_size, batches_per_epoch)
+                   if data else
+                   synthetic_batches(batch_size, batches_per_epoch))
+        for real in batches:
             noise = mx.nd.random.normal(shape=(batch_size, nz, 1, 1))
             # -- discriminator: max log D(x) + log(1 - D(G(z))) ----------
             with autograd.record():
@@ -112,7 +137,9 @@ if __name__ == "__main__":
     ap.add_argument("--batch-size", type=int, default=16)
     ap.add_argument("--nz", type=int, default=100)
     ap.add_argument("--lr", type=float, default=0.0002)
-    ap.add_argument("--synthetic", action="store_true", default=True)
+    ap.add_argument("--data", type=str, default=None,
+                    help="image folder; default: synthetic images")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
-    train(args.epochs, args.batch_size, args.nz, args.lr)
+    train(args.epochs, args.batch_size, args.nz, args.lr,
+          data=args.data)

@@ -19,7 +19,10 @@ import numpy as np
 import mxnet_tpu as mx
 
 from symbols import alexnet as _alexnet
+from symbols import googlenet as _googlenet
+from symbols import inception_bn as _incbn
 from symbols import inception_v3 as _inc3
+from symbols import mobilenet as _mobilenet
 from symbols import resnet as _resnet
 from symbols import resnext as _resnext
 from symbols import vgg as _vgg
@@ -29,6 +32,12 @@ def get_network(name):
     """Returns (symbol, image_shape)."""
     if name == "alexnet":
         return _alexnet.get_symbol(1000), (3, 224, 224)
+    if name == "googlenet":
+        return _googlenet.get_symbol(1000), (3, 224, 224)
+    if name == "inception-bn":
+        return _incbn.get_symbol(1000), (3, 224, 224)
+    if name == "mobilenet":
+        return _mobilenet.get_symbol(1000), (3, 224, 224)
     if name.startswith("vgg-"):
         parts = name.split("-")
         if len(parts) == 2 and parts[1].isdigit():

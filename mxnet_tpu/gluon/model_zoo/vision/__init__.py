@@ -16,6 +16,8 @@ _MODELS = {
     "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,  # noqa: F405
     "resnet50_v1": resnet50_v1, "resnet101_v1": resnet101_v1,  # noqa: F405
     "resnet152_v1": resnet152_v1,  # noqa: F405
+    "resnet50_v1b": resnet50_v1b, "resnet101_v1b": resnet101_v1b,  # noqa: F405
+    "resnet152_v1b": resnet152_v1b,  # noqa: F405
     "resnet18_v2": resnet18_v2, "resnet34_v2": resnet34_v2,  # noqa: F405
     "resnet50_v2": resnet50_v2, "resnet101_v2": resnet101_v2,  # noqa: F405
     "resnet152_v2": resnet152_v2,  # noqa: F405

@@ -16,13 +16,13 @@ def test_gluon_mnist_converges():
 
 def test_dcgan_trains():
     """One abbreviated epoch of adversarial training: both nets update
-    and the discriminator learns something (loss below the 2*log(2)
-    no-learning level)."""
+    and the discriminator actually learns (loss strictly below the
+    2*log(2) ~ 1.386 chance level)."""
     import dcgan
     _, _, d_loss, g_loss = dcgan.train(
         epochs=1, batch_size=8, batches_per_epoch=6)
     assert np_isfinite(d_loss) and np_isfinite(g_loss)
-    assert d_loss < 1.6, d_loss
+    assert d_loss < 1.3, d_loss
 
 
 def np_isfinite(x):

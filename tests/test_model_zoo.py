@@ -54,3 +54,23 @@ def test_model_zoo_train_step():
 def test_get_model_unknown():
     with pytest.raises(ValueError):
         vision.get_model("resnet9000")
+
+
+def test_resnet50_v1b_structure():
+    """v1b (stride on the 3x3) keeps v1's parameter count and output
+    surface; only stride placement differs (the torchvision/gluoncv
+    convention — the form the reference's benchmark symbol uses)."""
+    net = vision.resnet50_v1b(classes=1000)
+    net.initialize()
+    x = mx.nd.zeros((1, 3, 224, 224))
+    out = net(x)
+    assert out.shape == (1, 1000)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in net.collect_params().values())
+    v1 = vision.resnet50_v1(classes=1000)
+    v1.initialize()
+    v1(x)
+    n_v1 = sum(int(np.prod(p.shape))
+               for p in v1.collect_params().values())
+    assert n_params == n_v1, (n_params, n_v1)
+    assert vision.get_model("resnet50_v1b", classes=10) is not None
