@@ -45,6 +45,16 @@ def create(init, **kwargs):
     if callable(init) and not isinstance(init, type):
         return init
     if isinstance(init, str):
+        if init.startswith("["):
+            # Initializer.dumps() JSON: ["name", {kwargs}] — the format
+            # stored in a Variable's __init__ attr (reference:
+            # initializer.py InitDesc handling)
+            name, init_kwargs = json.loads(init)
+            name = name.lower()
+            if name not in _INIT_REGISTRY:
+                raise ValueError(f"Unknown initializer {name!r}; known: "
+                                 f"{sorted(_INIT_REGISTRY)}")
+            return _INIT_REGISTRY[name](**init_kwargs)
         name = init.lower()
         if name not in _INIT_REGISTRY:
             raise ValueError(f"Unknown initializer {init!r}; known: "

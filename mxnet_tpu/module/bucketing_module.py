@@ -136,19 +136,35 @@ class BucketingModule(BaseModule):
 
         symbol, data_names, label_names = \
             self._call_sym_gen(self._default_bucket_key)
+        # fused=False: every bucket must flow through the ONE shared
+        # eager updater and the aliased arg arrays; a fused per-bucket
+        # step would keep optimizer state inside its private program
+        # and fork momentum between buckets
         module = Module(symbol, data_names, label_names, logger=self.logger,
                         context=self._context,
                         work_load_list=self._work_load_list,
                         fixed_param_names=self._fixed_param_names,
                         state_names=self._state_names,
                         group2ctxs=self._group2ctxs,
-                        compression_params=self._compression_params)
+                        compression_params=self._compression_params,
+                        fused=False)
         module.bind(data_shapes, label_shapes, for_training,
                     inputs_need_grad, force_rebind=False,
                     shared_module=None, grad_req=self._grad_req)
         self._curr_module = module
         self._curr_bucket_key = self._default_bucket_key
         self._buckets[self._default_bucket_key] = module
+
+    def _borrow_optimizer(self, module):
+        """Point ``module`` at the default bucket's optimizer/updater so
+        every bucket steps ONE shared optimizer (reference:
+        module.borrow_optimizer in bucketing_module.py:306)."""
+        default = self._buckets[self._default_bucket_key]
+        module._optimizer = default._optimizer
+        module._updater = default._updater
+        module._kvstore = default._kvstore
+        module._update_on_kvstore = default._update_on_kvstore
+        module.optimizer_initialized = True
 
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
         """(reference: bucketing_module.py:306)"""
@@ -161,12 +177,19 @@ class BucketingModule(BaseModule):
                             fixed_param_names=self._fixed_param_names,
                             state_names=self._state_names,
                             group2ctxs=self._group2ctxs,
-                            compression_params=self._compression_params)
+                            compression_params=self._compression_params,
+                            fused=False)
             module.bind(data_shapes, label_shapes, self._curr_module.
                         for_training, self._curr_module.inputs_need_grad,
                         force_rebind=False, shared_module=self._buckets[
                             self._default_bucket_key],
                         grad_req=self._grad_req)
+            if self.optimizer_initialized:
+                # a bucket created after init_optimizer borrows the ONE
+                # shared optimizer/updater — per-bucket optimizer copies
+                # would fork momentum state (reference:
+                # bucketing_module.py switch_bucket borrow_optimizer)
+                self._borrow_optimizer(module)
             self._buckets[bucket_key] = module
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key
@@ -183,11 +206,7 @@ class BucketingModule(BaseModule):
                                          force_init=force_init)
         for mod in self._buckets.values():
             if mod is not self._curr_module:
-                mod._optimizer = self._curr_module._optimizer
-                mod._updater = self._curr_module._updater
-                mod._kvstore = self._curr_module._kvstore
-                mod._update_on_kvstore = \
-                    self._curr_module._update_on_kvstore
+                self._borrow_optimizer(mod)
                 mod.optimizer_initialized = True
         self.optimizer_initialized = True
 

@@ -433,6 +433,16 @@ class Symbol:
             if node.op is None:
                 if node.name in shapes:
                     node_out_shapes[(id(node), 0)] = shapes[node.name]
+                elif "__shape__" in node.attrs:
+                    # Variable(shape=...) declared its own shape
+                    # (reference: mx.sym.var shape kwarg seeds InferShape).
+                    # 0 means unknown-dim in the reference convention —
+                    # only fully-known shapes may seed, else eval_shape
+                    # would happily propagate zero-sized arrays
+                    s = tuple(parse_attr(node.attrs["__shape__"]))
+                    if all(int(d) > 0 for d in s):
+                        shapes[node.name] = s
+                        node_out_shapes[(id(node), 0)] = s
                 return
             in_shapes = []
             for p, i in node.inputs:
@@ -672,6 +682,13 @@ def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
     if shape is not None:
         attrs["__shape__"] = str(tuple(shape))
     node = _Node(None, name, attrs=attrs)
+    if init is not None:
+        # user_attrs reach Module.init_params via attr_dict -> InitDesc's
+        # __init__ override (initializer.py:96); instances serialize as
+        # dumps() JSON so constructor args survive (reference stores
+        # init.dumps() the same way)
+        node.user_attrs["__init__"] = init if isinstance(init, str) \
+            else init.dumps()
     if attr:
         node.user_attrs.update(attr)
     from ..attribute import apply_scope_attrs
