@@ -1,0 +1,104 @@
+"""Mesh collective microbenchmark — the TPU analog of the reference's
+tools/bandwidth (which measured kvstore push/pull allreduce bandwidth over
+GPUs/machines). Here the collectives are XLA ops over a jax Mesh: psum
+(allreduce), all_gather, reduce_scatter (psum_scatter), and ppermute (the
+ring primitive behind ring attention / pipeline transfers).
+
+Reports per-collective algorithmic bandwidth:
+    busbw = bytes_moved_per_device / time
+with the standard allreduce convention bytes_moved = 2*(n-1)/n * size.
+
+Run on a real multi-chip mesh this measures ICI; on the virtual CPU mesh
+(XLA_FLAGS=--xla_force_host_platform_device_count=8) it validates the
+harness and the collectives' correctness, not hardware bandwidth.
+
+Usage: python tools/collective_bench.py [--sizes-mb 1,16,64] [--steps 20]
+Prints one JSON line per (collective, size).
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def build_ops(mesh, axis="x"):
+    n = mesh.devices.size
+
+    def wrap(f):
+        return jax.jit(
+            jax.shard_map(f, mesh=mesh, in_specs=P(axis),
+                          out_specs=P(axis)))
+
+    ops = {
+        "psum": (wrap(lambda x: jax.lax.psum(x, axis)),
+                 lambda size: 2 * (n - 1) / n * size),
+        "ppermute": (wrap(lambda x: jax.lax.ppermute(
+            x, axis, [(i, (i + 1) % n) for i in range(n)])),
+            lambda size: size / n),
+    }
+
+    def ag(x):
+        return jax.lax.all_gather(x, axis, tiled=True)
+
+    def rs(x):
+        return jax.lax.psum_scatter(x, axis, tiled=True)
+
+    ops["all_gather"] = (
+        jax.jit(jax.shard_map(ag, mesh=mesh, in_specs=P(axis),
+                              out_specs=P())),
+        lambda size: (n - 1) / n * size)
+    ops["reduce_scatter"] = (
+        jax.jit(jax.shard_map(rs, mesh=mesh, in_specs=P(axis),
+                              out_specs=P(axis))),
+        lambda size: (n - 1) / n * size)
+    return ops
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes-mb", default="1,16,64")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--dtype", default="float32")
+    args = ap.parse_args()
+
+    devices = np.array(jax.devices())
+    mesh = Mesh(devices, ("x",))
+    n = devices.size
+    ops = build_ops(mesh)
+    dtype = jnp.dtype(args.dtype)
+
+    for size_mb in (float(s) for s in args.sizes_mb.split(",")):
+        nelem = int(size_mb * 2 ** 20 / dtype.itemsize)
+        nelem -= nelem % n or n  # divisible by the axis size
+        x = jax.device_put(
+            jnp.arange(nelem, dtype=dtype),
+            NamedSharding(mesh, P("x")))
+        for name, (fn, moved) in ops.items():
+            y = fn(x)
+            jax.block_until_ready(y)       # compile
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                y = fn(x)
+            jax.block_until_ready(y)
+            dt = (time.perf_counter() - t0) / args.steps
+            size_bytes = nelem * dtype.itemsize
+            busbw = moved(size_bytes) / dt
+            print(json.dumps({
+                "collective": name, "devices": n,
+                "size_mb": round(size_bytes / 2 ** 20, 2),
+                "time_us": round(dt * 1e6, 1),
+                "busbw_gb_s": round(busbw / 1e9, 3),
+                "platform": devices.flat[0].platform,
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
