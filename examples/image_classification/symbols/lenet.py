@@ -15,8 +15,9 @@ def get_symbol(num_classes=10, add_stn=False, **kwargs):
     tanh2 = mx.sym.Activation(data=conv2, act_type="tanh")
     pool2 = mx.sym.Pooling(data=tanh2, pool_type="max", kernel=(2, 2),
                            stride=(2, 2))
-    # first fullc
-    flatten = mx.sym.Flatten(data=pool2)
+    # first fullc (explicit name: fine-tune recipes cut at "flatten0",
+    # which must not depend on the process-global auto-name counter)
+    flatten = mx.sym.Flatten(data=pool2, name="flatten0")
     fc1 = mx.sym.FullyConnected(data=flatten, num_hidden=500)
     tanh3 = mx.sym.Activation(data=fc1, act_type="tanh")
     # second fullc

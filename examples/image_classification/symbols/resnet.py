@@ -54,7 +54,7 @@ def residual_unit(data, num_filter, stride, dim_match, name,
 
 
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
-           bottle_neck=True, bn_mom=0.9):
+           bottle_neck=True, bn_mom=0.9, stem="std"):
     data = mx.sym.Variable("data")
     nchannel, height, _ = image_shape
     data = mx.sym.BatchNorm(data=data, fix_gamma=True, eps=2e-5,
@@ -64,9 +64,21 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
                                   kernel=(3, 3), stride=(1, 1), pad=(1, 1),
                                   no_bias=True, name="conv0")
     else:  # imagenet stem
-        body = mx.sym.Convolution(data=data, num_filter=filter_list[0],
-                                  kernel=(7, 7), stride=(2, 2), pad=(3, 3),
-                                  no_bias=True, name="conv0")
+        if stem == "s2d":
+            # exact space-to-depth rewrite of the 7x7/s2/p3 stem —
+            # identical math and the identical (O,C,7,7) weight
+            # (checkpoint-compatible); quadruples the MXU contraction
+            # depth (ops/nn.py conv_s2d_stem)
+            w0 = mx.sym.Variable("conv0_weight",
+                                 shape=(filter_list[0], nchannel, 7, 7))
+            body = mx.sym.conv_s2d_stem(data=data, weight=w0,
+                                        name="conv0")
+        else:
+            body = mx.sym.Convolution(data=data,
+                                      num_filter=filter_list[0],
+                                      kernel=(7, 7), stride=(2, 2),
+                                      pad=(3, 3), no_bias=True,
+                                      name="conv0")
         body = mx.sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
                                 momentum=bn_mom, name="bn0")
         body = mx.sym.Activation(data=body, act_type="relu", name="relu0")
@@ -130,4 +142,5 @@ def get_symbol(num_classes, num_layers, image_shape, **kwargs):
         units = units_map[num_layers]
     return resnet(units=units, num_stages=num_stages,
                   filter_list=filter_list, num_classes=num_classes,
-                  image_shape=image_shape, bottle_neck=bottle_neck)
+                  image_shape=image_shape, bottle_neck=bottle_neck,
+                  stem=kwargs.get("stem", "std"))

@@ -108,12 +108,25 @@ class EvalMetric:
     def update(self, labels, preds):
         raise NotImplementedError
 
+    def _sync_device(self, keep=True):
+        """Fold (or drop) pending device-side counters — the fused Module
+        path accumulates on device and only syncs when the metric is
+        actually read (metric_device.py)."""
+        if getattr(self, "_dev_acc", None) is not None:
+            from . import metric_device
+            if keep:
+                metric_device.flush(self)
+            else:
+                metric_device.discard(self)
+
     def reset(self):
+        self._sync_device(keep=False)
         self.num_inst = 0
         self.sum_metric = 0.0
 
     def get(self):
         """Returns (name, value) (reference: metric.py:176)."""
+        self._sync_device()
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, self.sum_metric / self.num_inst)

@@ -234,10 +234,16 @@ class BaseModule:
             toc = time.time()
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
 
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
-
+            # the reference pulls params to host and re-broadcasts every
+            # epoch (base_module.py:617) to consolidate multi-device aux;
+            # with the single fused device state that roundtrip is a
+            # functional no-op and costs a full parameter down+up
+            # transfer, so it only runs when a callback consumes the
+            # host params (checkpointing). Eval paths sync lazily
+            # (module.forward: _params_dirty).
             if epoch_end_callback is not None:
+                arg_params_, aux_params_ = self.get_params()
+                self.set_params(arg_params_, aux_params_)
                 for callback in _as_list(epoch_end_callback):
                     callback(epoch, self.symbol, arg_params_, aux_params_)
 

@@ -11,6 +11,17 @@ and the pure optimizer rules with the gluon TrainStep
 (parallel.functional_opt). With a mesh, data/label inputs are sharded over
 the 'data' axis and parameters replicated; GSPMD inserts the gradient
 all-reduce exactly where the reference's KVStore did.
+
+Small-parameter packing: a ResNet-scale model carries ~160 parameters and
+~100 BatchNorm aux states, most of them tiny 1-D vectors. Handled as
+individual XLA buffers they fragment the step into thousands of small
+copies/converts (measured: ~1200 copy ops, ~4ms/step on v5e — see
+tools/step_profile.py). All 1-D float32 trainable parameters, their
+optimizer states, and all 1-D float32 aux states are therefore packed into
+single flat donated buffers; per-name values are static slices inside the
+program and the optimizer update over the packed buffer is one fused op
+(per-parameter lr_mult/wd_mult become per-element vectors — exact for
+every elementwise rule; norm-based rules like LARS disable packing).
 """
 from __future__ import annotations
 
@@ -66,52 +77,149 @@ class FusedSymbolStep:
         _, self._fwd_loss, _ = build_graph_fns(symbol)
         from .. import random as _random
         self._base_key = _random.next_key()
+        # big params / per-param opt state (aligned with _big_names)
         self._pvals = None
         self._opt_state = None
-        self._aux_vals = None
+        self._aux_vals = None          # big aux (aligned _aux_big_names)
+        # packed small params / their flat opt state / packed aux
+        self._flat_p = None
+        self._flat_state = None
+        self._flat_aux = None
+        # in-step metric counter slots (attach_metric / metric_device.py)
+        self._metric_sigs = []          # per-slot structural signature
+        self._metric_rules = None       # per-slot (None, ln, pn, fn)
+        self._metric_state = None       # per-slot device scalar
+        self._metric_owner = []         # per-slot weakref to the metric
+        self._metric_detach_epoch = 0   # bumped by detach_metrics
         self._t_dev = None
         self._step_jit = None
         self._lr_cache = None
         self.num_update = 0
+        # partition decided at start() from actual value shapes
+        self._big_names = None
+        self._small_names = None
+        self._aux_big_names = None
+        self._aux_small_names = None
 
     @property
     def started(self):
         return self._pvals is not None
 
     # -- state ----------------------------------------------------------------
+    def _rep_sharding(self):
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P())
+
+    def _partition(self, arg_dict, aux_dict):
+        """Decide which params/aux pack into the flat buffers."""
+        packable = (getattr(self._fopt, "elementwise", False)
+                    and not self._fopt.needs_key)
+        self._small_names, self._big_names = [], []
+        for n in self.param_names:
+            v = arg_dict[n]._data
+            if (packable and v.ndim <= 1 and v.dtype == jnp.float32
+                    and self.trainable.get(n, True)):
+                self._small_names.append(n)
+            else:
+                self._big_names.append(n)
+        self._aux_small_names, self._aux_big_names = [], []
+        for n in self.aux_names:
+            v = aux_dict[n]._data
+            if v.ndim <= 1 and v.dtype == jnp.float32:
+                self._aux_small_names.append(n)
+            else:
+                self._aux_big_names.append(n)
+        # static slice tables
+        self._small_off = {}
+        off = 0
+        for n in self._small_names:
+            sz = int(np.prod(arg_dict[n]._data.shape)) \
+                if arg_dict[n]._data.ndim else 1
+            self._small_off[n] = (off, sz, tuple(arg_dict[n]._data.shape))
+            off += sz
+        self._small_total = off
+        self._aux_off = {}
+        off = 0
+        for n in self._aux_small_names:
+            sz = int(np.prod(aux_dict[n]._data.shape)) \
+                if aux_dict[n]._data.ndim else 1
+            self._aux_off[n] = (off, sz, tuple(aux_dict[n]._data.shape))
+            off += sz
+        self._aux_total = off
+        # per-element lr/wd multiplier vectors for the packed update
+        if self._small_total:
+            lrm = np.ones(self._small_total, np.float32)
+            wdv = np.zeros(self._small_total, np.float32)
+            pidx = {n: i for i, n in enumerate(self.param_names)}
+            for n, (o, sz, _) in self._small_off.items():
+                lrm[o:o + sz] = self._lr_mults[pidx[n]]
+                wdv[o:o + sz] = self._wd_eff[pidx[n]]
+            self._flat_lrm = jnp.asarray(lrm)
+            self._flat_wd = jnp.asarray(wdv)
+
     def start(self, arg_dict, aux_dict):
         """Capture initial parameter/aux values (copies — our buffers get
         donated, the executor's must stay live for eval paths)."""
-        rep = None
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            rep = NamedSharding(self.mesh, P())
+        rep = self._rep_sharding()
 
         def _prep(v):
             v = jnp.array(v, copy=True)
             return jax.device_put(v, rep) if rep is not None else v
 
+        self._partition(arg_dict, aux_dict)
         self._pvals = tuple(_prep(arg_dict[n]._data)
-                            for n in self.param_names)
+                            for n in self._big_names)
         self._aux_vals = tuple(_prep(aux_dict[n]._data)
-                               for n in self.aux_names)
+                               for n in self._aux_big_names)
         self._opt_state = tuple(
             tuple(jax.device_put(x, rep) if rep is not None else x
                   for x in self._fopt.init(v))
             if self.trainable.get(n, True) else ()
-            for n, v in zip(self.param_names, self._pvals))
+            for n, v in zip(self._big_names, self._pvals))
+        self._flat_p = _prep(self._pack_params(arg_dict)) \
+            if self._small_total else None
+        self._flat_aux = _prep(self._pack_aux(aux_dict)) \
+            if self._aux_total else None
+        if self._small_total:
+            self._flat_state = tuple(
+                jax.device_put(x, rep) if rep is not None else x
+                for x in self._fopt.init(self._flat_p))
+        else:
+            self._flat_state = ()
         t0 = jnp.zeros((), jnp.uint32)
         self._t_dev = jax.device_put(t0, rep) if rep is not None else t0
+
+    def _pack_params(self, arg_dict):
+        vals = [np.asarray(arg_dict[n]._data).ravel()
+                for n in self._small_names]
+        return jnp.asarray(np.concatenate(vals).astype(np.float32))
+
+    def _pack_aux(self, aux_dict):
+        vals = [np.asarray(aux_dict[n]._data).ravel()
+                for n in self._aux_small_names]
+        return jnp.asarray(np.concatenate(vals).astype(np.float32))
 
     def _build(self):
         fwd_loss = self._fwd_loss
         fopt = self._fopt
         arg_names = self.arg_names
-        param_pos = {n: i for i, n in enumerate(self.param_names)}
+        big_pos = {n: i for i, n in enumerate(self._big_names)}
+        small_off = self._small_off
+        aux_big_pos = {n: i for i, n in enumerate(self._aux_big_names)}
+        aux_off = self._aux_off
         input_pos = {n: i for i, n in enumerate(self.input_names)}
-        trainable = [self.trainable.get(n, True) for n in self.param_names]
-        lr_mults, wd_eff = self._lr_mults, self._wd_eff
+        trainable = [self.trainable.get(n, True) for n in self._big_names]
+        pidx = {n: i for i, n in enumerate(self.param_names)}
+        lr_mults = [self._lr_mults[pidx[n]] for n in self._big_names]
+        wd_eff = [self._wd_eff[pidx[n]] for n in self._big_names]
         base_key = self._base_key
+        aux_names = self.aux_names
+        has_flat = self._small_total > 0
+        has_flat_aux = self._aux_total > 0
+        flat_lrm = self._flat_lrm if has_flat else None
+        flat_wd = self._flat_wd if has_flat else None
 
         cdt = self.compute_dtype
 
@@ -119,22 +227,47 @@ class FusedSymbolStep:
             return v.astype(cdt) if cdt is not None and \
                 v.dtype == jnp.float32 else v
 
-        def step_fn(pvals, opt_state, aux_vals, feed_vals, t, lr):
+        metric_rules = self._metric_rules or []
+        out_names = self.symbol.list_outputs()
+
+        def step_fn(pvals, opt_state, flat_p, flat_state, aux_vals,
+                    flat_aux, mstate, feed_vals, t, lr):
             key = jax.random.fold_in(base_key, t)
 
-            def floss(pv):
-                arg_vals = tuple(
-                    _cast(pv[param_pos[n]]) if n in param_pos
-                    else _cast(feed_vals[input_pos[n]])
-                    for n in arg_names)
-                total, (outs, aux_up) = fwd_loss(
-                    arg_vals, tuple(_cast(a) for a in aux_vals), None, key)
+            def floss(pv, fp):
+                def val(n):
+                    if n in big_pos:
+                        return _cast(pv[big_pos[n]])
+                    if n in small_off:
+                        o, sz, shp = small_off[n]
+                        return _cast(jax.lax.slice(fp, (o,), (o + sz,))
+                                     .reshape(shp))
+                    return _cast(feed_vals[input_pos[n]])
+
+                arg_vals = tuple(val(n) for n in arg_names)
+
+                def aux_val(n):
+                    if n in aux_big_pos:
+                        return _cast(aux_vals[aux_big_pos[n]])
+                    o, sz, shp = aux_off[n]
+                    return _cast(jax.lax.slice(flat_aux, (o,), (o + sz,))
+                                 .reshape(shp))
+
+                aux_in = tuple(aux_val(n) for n in aux_names)
+                total, (outs, aux_up) = fwd_loss(arg_vals, aux_in, None,
+                                                 key)
                 return total, (outs, aux_up)
 
-            grads, (outs, aux_up) = jax.grad(floss, has_aux=True)(pvals)
+            if has_flat:
+                grads, (outs, aux_up) = jax.grad(
+                    floss, argnums=(0, 1), has_aux=True)(pvals, flat_p)
+                grads_big, grad_flat = grads
+            else:
+                grads_big, (outs, aux_up) = jax.grad(
+                    floss, has_aux=True)(pvals, flat_p)
             new_p, new_s = [], []
             for i, (p, g, s, tr) in enumerate(
-                    zip(pvals, grads, opt_state, trainable)):
+                    zip(pvals, grads_big, opt_state, trainable)):
                 if tr:
                     pkey = jax.random.fold_in(
                         jax.random.fold_in(key, 0x6F707469), i) \
@@ -146,12 +279,54 @@ class FusedSymbolStep:
                 else:
                     new_p.append(p)
                     new_s.append(s)
-            new_aux = tuple(
+            if has_flat:
+                nf, nfs = fopt.update(flat_p, grad_flat, flat_state,
+                                      lr * flat_lrm, t + 1, flat_wd)
+                new_flat, new_flat_s = nf.astype(jnp.float32), nfs
+            else:
+                new_flat, new_flat_s = flat_p, flat_state
+            new_aux_big = tuple(
                 aux_up.get(n, a).astype(a.dtype)
-                for n, a in zip(self.aux_names, aux_vals))
-            return tuple(new_p), tuple(new_s), new_aux, tuple(outs), t + 1
+                for n, a in zip(self._aux_big_names, aux_vals))
+            if has_flat_aux:
+                pieces = []
+                for n in self._aux_small_names:
+                    o, sz, shp = aux_off[n]
+                    cur = jax.lax.slice(flat_aux, (o,), (o + sz,))
+                    up = aux_up.get(n)
+                    pieces.append(
+                        up.reshape(sz).astype(jnp.float32)
+                        if up is not None else cur)
+                new_flat_aux = jnp.concatenate(pieces) if pieces \
+                    else flat_aux
+            else:
+                new_flat_aux = flat_aux
+            # in-step metric counters (metric_device.py): one device
+            # scalar per attached metric, advanced inside THIS program so
+            # update_metric never adds a dispatch or a sync
+            if metric_rules:
+                pred_map = dict(zip(out_names, outs))
+                label_map = {n: feed_vals[input_pos[n]]
+                             for n in self.input_names}
+                new_m = tuple(
+                    fn(s, [label_map[n] for n in lnames],
+                       [pred_map[n] for n in pnames])
+                    for (init, lnames, pnames, fn), s
+                    in zip(metric_rules, mstate))
+            else:
+                new_m = mstate
+            return (tuple(new_p), tuple(new_s), new_flat, new_flat_s,
+                    new_aux_big, new_flat_aux, new_m, tuple(outs), t + 1)
 
-        donate = (0, 1, 2, 4)
+        donate = (0, 1, 2, 3, 4, 5, 6, 8)
+        # backend compiler options (reference analog: the MXNET_* perf env
+        # layer, docs/faq/env_var.md): MXNET_TPU_XLA_OPTIONS="k=v,k2=v2"
+        import os
+        jit_kw = {}
+        opts = os.environ.get("MXNET_TPU_XLA_OPTIONS")
+        if opts:
+            jit_kw["compiler_options"] = dict(
+                kv.split("=", 1) for kv in opts.split(",") if "=" in kv)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             rep = NamedSharding(self.mesh, P())
@@ -159,21 +334,105 @@ class FusedSymbolStep:
             shard_inputs = set(self.data_names) | set(self.label_names)
             feed_sh = tuple(batched if n in shard_inputs else rep
                             for n in self.input_names)
-            prep = tuple(rep for _ in self.param_names)
+            prep = tuple(rep for _ in self._big_names)
             srep = tuple(tuple(rep for _ in st) for st in self._opt_state)
-            arep = tuple(rep for _ in self.aux_names)
-            in_shardings = (prep, srep, arep, feed_sh, rep, rep)
+            frep = rep if self._flat_p is not None else None
+            fsrep = tuple(rep for _ in self._flat_state)
+            farep = rep if self._flat_aux is not None else None
+            arep = tuple(rep for _ in self._aux_big_names)
+            mrep = tuple(rep for _ in (self._metric_state or ()))
+            in_shardings = (prep, srep, frep, fsrep, arep, farep, mrep,
+                            feed_sh, rep, rep)
             # pin state outputs to their input layout (keeps donation
             # zero-copy); leave graph outputs (None) to GSPMD
-            out_shardings = (prep, srep, arep,
+            out_shardings = (prep, srep, frep, fsrep, arep, farep, mrep,
                              None, rep)
             self._step_jit = jax.jit(step_fn, donate_argnums=donate,
                                      in_shardings=in_shardings,
-                                     out_shardings=out_shardings)
+                                     out_shardings=out_shardings,
+                                     **jit_kw)
         else:
-            self._step_jit = jax.jit(step_fn, donate_argnums=donate)
+            self._step_jit = jax.jit(step_fn, donate_argnums=donate,
+                                     **jit_kw)
 
     # -- run ------------------------------------------------------------------
+    def _state_args(self):
+        return (self._pvals, self._opt_state, self._flat_p,
+                self._flat_state, self._aux_vals, self._flat_aux,
+                self._metric_state or ())
+
+    # -- in-step metrics (metric_device.py) ------------------------------------
+    def attach_metric(self, metric, sig, init, lnames, pnames, fn):
+        """Claim an in-step counter slot for ``metric``: one device
+        scalar advanced by ``fn`` inside the step program. A slot whose
+        previous owner died (or is this very metric) and whose
+        structural signature matches is REUSED — no retrace, counter
+        reset to ``init``; otherwise a new slot appends and the step
+        retraces once. Returns the slot index."""
+        import weakref
+        rep = self._rep_sharding()
+        dinit = jax.device_put(init, rep) if rep is not None \
+            else jnp.asarray(init)
+        if self._metric_rules is None:
+            self._metric_rules = []
+            self._metric_state = ()
+        for i, s in enumerate(self._metric_sigs):
+            owner = self._metric_owner[i]
+            o = owner() if owner is not None else None
+            if s == sig and (o is None or o is metric):
+                self._metric_owner[i] = weakref.ref(metric)
+                self._metric_state = tuple(
+                    dinit if j == i else v
+                    for j, v in enumerate(self._metric_state))
+                return i
+        idx = len(self._metric_sigs)
+        self._metric_sigs.append(sig)
+        self._metric_rules.append((None, lnames, pnames, fn))
+        self._metric_state = self._metric_state + (dinit,)
+        self._metric_owner.append(weakref.ref(metric))
+        self._step_jit = None              # retrace with the new slot
+        return idx
+
+    def live_metrics(self):
+        """Currently-owned attached metric objects (for flush hooks)."""
+        out = []
+        for wr in self._metric_owner:
+            m = wr() if wr is not None else None
+            if m is not None:
+                out.append(m)
+        return out
+
+    def detach_metrics(self):
+        """Drop every in-step metric rule (executor reshape — shape
+        templates and per-step instance counts would go stale).
+        metric_device flushes live refs first."""
+        if self._metric_rules:
+            self._metric_sigs = []
+            self._metric_rules = None
+            self._metric_state = None
+            self._metric_owner = []
+            self._metric_detach_epoch += 1
+            self._step_jit = None
+
+    def release_metric_slot(self, idx):
+        """Disown one slot (metric fell back to the sync path); the rule
+        keeps running (retrace-free) until the slot is reused."""
+        if idx < len(self._metric_owner):
+            self._metric_owner[idx] = None
+
+    def reset_metric_state(self, idx):
+        if self._metric_state is None:
+            return
+        rep = self._rep_sharding()
+        z = jnp.zeros_like(self._metric_state[idx])
+        if rep is not None:
+            z = jax.device_put(np.zeros(self._metric_state[idx].shape,
+                                        self._metric_state[idx].dtype),
+                               rep)
+        self._metric_state = tuple(
+            z if i == idx else s
+            for i, s in enumerate(self._metric_state))
+
     def step(self, feed, lr):
         """Run one fused step. ``feed``: dict name -> jnp array for every
         input (data + label [+ states]); ``lr``: host scalar base learning
@@ -198,37 +457,78 @@ class FusedSymbolStep:
                 lr_dev = jax.device_put(
                     lr_dev, NamedSharding(self.mesh, P()))
             self._lr_cache = (lr, lr_dev)
-        self._pvals, self._opt_state, self._aux_vals, outs, self._t_dev = \
-            self._step_jit(self._pvals, self._opt_state, self._aux_vals,
-                           tuple(feed_vals), self._t_dev, self._lr_cache[1])
+        (self._pvals, self._opt_state, self._flat_p, self._flat_state,
+         self._aux_vals, self._flat_aux, self._metric_state, outs,
+         self._t_dev) = \
+            self._step_jit(*self._state_args(), tuple(feed_vals),
+                           self._t_dev, self._lr_cache[1])
         self.num_update += 1
         return outs
+
+    def lowered(self, feed):
+        """Lower the step for the given feed dict (tools/bench introspection
+        — keeps the jit signature private to this class)."""
+        if self._step_jit is None:
+            self._build()
+        feed_vals = tuple(feed[n] for n in self.input_names)
+        if self._lr_cache is None:
+            self._lr_cache = (0.0, jnp.asarray(0.0, jnp.float32))
+        return self._step_jit.lower(*self._state_args(), feed_vals,
+                                    self._t_dev, self._lr_cache[1])
 
     def load_params(self, arg_dict, aux_dict):
         """Refresh parameter/aux buffers from executor arrays (set_params
         mid-run); optimizer state is kept, matching the eager Updater."""
-        rep = None
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            rep = NamedSharding(self.mesh, P())
+        rep = self._rep_sharding()
 
         def _prep(v):
             v = jnp.array(v, copy=True)
             return jax.device_put(v, rep) if rep is not None else v
 
         self._pvals = tuple(_prep(arg_dict[n]._data)
-                            for n in self.param_names)
+                            for n in self._big_names)
         self._aux_vals = tuple(_prep(aux_dict[n]._data)
-                               for n in self.aux_names)
+                               for n in self._aux_big_names)
+        if self._small_total:
+            self._flat_p = _prep(self._pack_params(arg_dict))
+        if self._aux_total:
+            self._flat_aux = _prep(self._pack_aux(aux_dict))
 
     # -- sync -----------------------------------------------------------------
     def sync_to(self, arg_dict, aux_dict):
         """Copy current parameter/aux buffers back into executor arrays.
         Copies, not references — our buffers are donated next step."""
-        for n, v in zip(self.param_names, self._pvals):
+        for n, v in zip(self._big_names, self._pvals):
             arg_dict[n]._data = jnp.array(v, copy=True)
-        for n, v in zip(self.aux_names, self._aux_vals):
+        if self._small_total:
+            flat = np.asarray(self._flat_p)
+            for n in self._small_names:
+                o, sz, shp = self._small_off[n]
+                arg_dict[n]._data = jnp.asarray(
+                    flat[o:o + sz].reshape(shp))
+        for n, v in zip(self._aux_big_names, self._aux_vals):
             aux_dict[n]._data = jnp.array(v, copy=True)
+        if self._aux_total:
+            flat = np.asarray(self._flat_aux)
+            for n in self._aux_small_names:
+                o, sz, shp = self._aux_off[n]
+                aux_dict[n]._data = jnp.asarray(
+                    flat[o:o + sz].reshape(shp))
+
+    # -- per-name views (packed-aware) ----------------------------------------
+    def _param_state(self, n):
+        """Optimizer state leaves for one parameter, as numpy arrays."""
+        if n in self._big_names:
+            return tuple(np.asarray(x)
+                         for x in self._opt_state[
+                             self._big_names.index(n)])
+        o, sz, shp = self._small_off[n]
+        # non-parameter-shaped leaves (e.g. nadam's scalar m_schedule) are
+        # shared across the pack — emit them whole for every name
+        return tuple(
+            np.asarray(leaf)[o:o + sz].reshape(shp)
+            if getattr(leaf, "ndim", 0) == 1 else np.asarray(leaf)
+            for leaf in self._flat_state)
 
     # -- optimizer state io ----------------------------------------------------
     def get_states(self):
@@ -237,8 +537,7 @@ class FusedSymbolStep:
             "__mxnet_tpu_fused__": 1,
             "optimizer": type(self.optimizer).__name__.lower(),
             "num_update": self.num_update,
-            "state": {n: tuple(np.asarray(x) for x in st)
-                      for n, st in zip(self.param_names, self._opt_state)},
+            "state": {n: self._param_state(n) for n in self.param_names},
         })
 
     def set_states(self, data):
@@ -261,7 +560,7 @@ class FusedSymbolStep:
         self.num_update = obj["num_update"]
         self._t_dev = jnp.asarray(self.num_update, jnp.uint32)
         new_state = []
-        for n, cur in zip(self.param_names, self._opt_state):
+        for n, cur in zip(self._big_names, self._opt_state):
             saved = obj["state"].get(n)
             if saved is None:
                 new_state.append(cur)
@@ -274,3 +573,25 @@ class FusedSymbolStep:
                 jnp.asarray(s, dtype=getattr(c, "dtype", jnp.float32))
                 for s, c in zip(saved, cur)))
         self._opt_state = tuple(new_state)
+        if self._small_total and self._flat_state:
+            leaves = [np.asarray(leaf).copy()
+                      for leaf in self._flat_state]
+            for n in self._small_names:
+                saved = obj["state"].get(n)
+                if saved is None:
+                    continue
+                if len(saved) != len(leaves):
+                    raise MXNetError(
+                        f"saved optimizer state for '{n}' has "
+                        f"{len(saved)} leaves, expected {len(leaves)} — "
+                        f"optimizer mismatch?")
+                o, sz, _ = self._small_off[n]
+                for j, sv in enumerate(saved):
+                    if leaves[j].ndim == 1:
+                        leaves[j][o:o + sz] = np.asarray(sv).ravel()
+                    else:
+                        # pack-shared leaf (scalar schedule): identical
+                        # for every name, last write wins
+                        leaves[j] = np.asarray(sv).reshape(
+                            leaves[j].shape)
+            self._flat_state = tuple(jnp.asarray(x) for x in leaves)

@@ -474,7 +474,14 @@ class Module(BaseModule):
             if tuple(self._exec.arg_dict[name].shape) != tuple(arr.shape):
                 new_shapes = {n: tuple(a.shape) for n, a in feed.items()}
                 self._exec = self._exec.reshape(**new_shapes)
+                if self._fused is not None and \
+                        getattr(self._fused, "_metric_rules", None):
+                    # in-step metric templates/instance counts are
+                    # per-shape: fold what's counted, re-attach lazily
+                    from .. import metric_device
+                    metric_device.flush_and_detach(self._fused)
                 break
+        self._fused_outs_live = False
         if is_train and self._fused is not None:
             import jax.numpy as jnp
             self._fused_feed = {
@@ -519,6 +526,7 @@ class Module(BaseModule):
             opt.num_update = self._fused.num_update
             from ..ndarray.ndarray import _wrap
             self._exec.outputs = [_wrap(o) for o in outs]
+            self._fused_outs_live = True
             return
         if self._kvstore is not None and self._update_on_kvstore:
             for i, name in enumerate(self._param_names):
@@ -558,9 +566,25 @@ class Module(BaseModule):
 
     def update_metric(self, eval_metric, labels):
         """(reference: module.py:736). get_outputs() materializes the
-        forward when called between a fused forward() and update()."""
+        forward when called between a fused forward() and update().
+
+        On the fused path the update is NON-BLOCKING for supported
+        metrics: counters accumulate on device along the step's async
+        dependency chain and sync only when the metric is read
+        (Speedometer interval / epoch log) — metric_device.py."""
+        label_dict = dict(zip(self._label_names, labels or []))
+        if self._fused is not None and self._exec.outputs and \
+                getattr(self, "_fused_outs_live", False):
+            # only when these outputs came from a fused TRAIN step —
+            # in-step counters advance once per step, so eval/eager
+            # forwards must take the synchronous path
+            from .. import metric_device
+            if metric_device.inline_update(
+                    self._fused, eval_metric, label_dict,
+                    dict(zip(self._output_names, self._exec.outputs))):
+                return
         eval_metric.update_dict(
-            dict(zip(self._label_names, labels or [])),
+            label_dict,
             dict(zip(self._output_names, self.get_outputs())))
 
     def install_monitor(self, mon):

@@ -31,13 +31,25 @@ __all__ = ["create", "from_optimizer", "supported", "FunctionalOptimizer"]
 
 
 class FunctionalOptimizer:
-    """A pure optimizer rule: closures over static hyperparameters."""
+    """A pure optimizer rule: closures over static hyperparameters.
 
-    def __init__(self, name, init_fn, update_fn, needs_key=False):
+    ``elementwise`` declares that ``update`` is purely per-element given
+    (lr, wd) — i.e. running it on a flat concatenation of parameters with
+    per-element lr/wd vectors is exact. Only per-tensor-norm rules opt
+    out (lbsgd/lars with warmup_strategy='lars'); the fused Module step
+    uses the flag to gate small-parameter packing (module/fused.py).
+    State leaves from ``init`` may be parameter-shaped or scalar
+    (pack-shared, e.g. nadam's m_schedule) — any other shape would break
+    the packed state IO.
+    """
+
+    def __init__(self, name, init_fn, update_fn, needs_key=False,
+                 elementwise=True):
         self.name = name
         self.init = init_fn            # p -> state tuple
         self._update = update_fn       # (p, g, s, lr, t, wd, key) -> (p, s)
         self.needs_key = needs_key
+        self.elementwise = elementwise
 
     def update(self, p, g, s, lr, t, wd=0.0, key=None):
         return self._update(p, g, s, lr, t, wd, key)
@@ -204,7 +216,9 @@ def _make_lbsgd(kw):
         mom = momentum * mom + lr * (g + wd * p)
         return p - mom, (mom,)
 
-    return FunctionalOptimizer("lbsgd", init, update)
+    # the 'lars' strategy is per-tensor-norm based — not elementwise
+    return FunctionalOptimizer("lbsgd", init, update,
+                               elementwise=(strategy != "lars"))
 
 
 @_factory("lars")

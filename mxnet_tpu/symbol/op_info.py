@@ -12,6 +12,7 @@ are not arguments and receive no gradient.
 OP_INPUTS = {
     "FullyConnected": (["data", "weight", "bias"], []),
     "Convolution": (["data", "weight", "bias"], []),
+    "conv_s2d_stem": (["data", "weight"], []),
     "Deconvolution": (["data", "weight", "bias"], []),
     "BatchNorm": (["data", "gamma", "beta"], ["moving_mean", "moving_var"]),
     "BatchNorm_v1": (["data", "gamma", "beta"],

@@ -172,3 +172,32 @@ def test_degrade_rules():
     mod.update()
     with pytest.raises(MXNetError, match="fused"):
         mod.backward(out_grads=[mx.nd.ones((40, 4))])
+
+
+def test_fused_scalar_state_leaf_roundtrip():
+    """Packed-state IO with a pack-shared scalar leaf (nadam m_schedule):
+    1-D params pack into the flat buffer, whose nadam state carries a 0-d
+    m_schedule leaf — get_states/set_states must treat it as shared, not
+    slice it per name (r5 code-review regression)."""
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(context=mx.cpu(0), symbol=net, fused=True)
+    mod.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="nadam")
+    b = mx.io.DataBatch([mx.nd.array(np.random.rand(8, 6))],
+                        [mx.nd.array(np.zeros(8))])
+    for _ in range(2):
+        mod.forward(b, is_train=True)
+        mod.backward()
+        mod.update()
+    assert mod._fused._small_names, "fc bias should pack"
+    states = mod._fused.get_states()
+    mod._fused.set_states(states)
+    mod.forward(b, is_train=True)
+    mod.backward()
+    mod.update()
+    sched = mod._fused._flat_state[2]
+    assert np.asarray(sched).ndim == 0 and float(np.asarray(sched)) < 1.0

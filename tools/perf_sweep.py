@@ -1,0 +1,67 @@
+"""Quick A/B throughput sweep of the fused Module step on the real chip.
+
+Usage: python tools/perf_sweep.py "std:128" "s2d:128" "s2d:256" ...
+Each spec is stem:batch. Prints img/s and implied model-FLOPs MFU.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+MODEL_FLOPS_PER_IMG = 3 * 4.089e9
+PEAK = 197e12  # v5e bf16
+
+
+def measure(stem, batch, steps=30):
+    import jax
+    import mxnet_tpu as mx
+    from hlo_breakdown import build_model
+    model = build_model(batch, stem=stem)
+    rng = np.random.RandomState(0)
+    n_host = 4
+    batches = [mx.io.DataBatch(
+        [mx.nd.array(rng.rand(batch, 3, 224, 224).astype(np.float32))],
+        [mx.nd.array(rng.randint(0, 1000, (batch,)).astype(np.int32))])
+        for _ in range(n_host)]
+
+    def run(b):
+        model.forward(b, is_train=True)
+        model.backward()
+        model.update()
+
+    for b in batches:
+        run(b)
+    # arm blocking semantics on the tunneled runtime (see bench.py)
+    np.asarray(jax.device_get(model._fused._pvals[0]))
+    jax.block_until_ready(model._fused._pvals)
+    dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            run(batches[i % n_host])
+        jax.block_until_ready(model._fused._pvals)
+        dt = min(dt, time.perf_counter() - t0)
+    step = dt / steps
+    img_s = batch / step
+    mfu = MODEL_FLOPS_PER_IMG * batch / step / PEAK
+    return img_s, step, mfu
+
+
+def main():
+    specs = sys.argv[1:] or ["std:128", "s2d:128"]
+    for spec in specs:
+        stem, batch = spec.split(":")
+        img_s, step, mfu = measure(stem, int(batch))
+        print(f"{spec:>10}: {img_s:8.1f} img/s  step={step*1e3:6.2f} ms  "
+              f"mfu={mfu:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

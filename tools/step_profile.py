@@ -1,0 +1,231 @@
+"""Per-op TIME breakdown of the fused training step via xplane trace.
+
+Complements tools/hlo_breakdown.py (static FLOPs): runs the exact benched
+fused step under jax.profiler and aggregates device-side op durations from
+the xplane, so the slow HLOs are identified by measurement, not guessed.
+
+Usage: python tools/step_profile.py [batch] [--stem=s2d]
+"""
+from __future__ import annotations
+
+import glob
+import os
+import re
+import sys
+import tempfile
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from hlo_breakdown import build_model
+
+    batch = 128
+    stem = "std"
+    for a in sys.argv[1:]:
+        if a.startswith("--stem="):
+            stem = a.split("=", 1)[1]
+        elif a.isdigit():
+            batch = int(a)
+
+    model = build_model(batch, stem=stem)
+    rng = np.random.RandomState(0)
+    b = mx.io.DataBatch(
+        [mx.nd.array(rng.rand(batch, 3, 224, 224).astype(np.float32))],
+        [mx.nd.array(rng.randint(0, 1000, (batch,)).astype(np.int32))])
+    # dump THIS program's optimized HLO for category mapping (a stale
+    # dump from another run would misattribute %fusion.N names)
+    from hlo_breakdown import lower_step
+    hlo = lower_step(model, batch).as_text()
+    with open("/tmp/fused_step.hlo", "w") as f:
+        f.write(hlo)
+
+    def run_step():
+        model.forward(b, is_train=True)
+        model.backward()
+        model.update()
+
+    for _ in range(3):
+        run_step()
+    jax.block_until_ready(model._fused._pvals)
+
+    tmp = tempfile.mkdtemp(prefix="xplane_")
+    with jax.profiler.trace(tmp):
+        for _ in range(5):
+            run_step()
+        jax.block_until_ready(model._fused._pvals)
+
+    paths = glob.glob(os.path.join(tmp, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        print("no xplane produced under", tmp)
+        return
+    pd = jax.profiler.ProfileData.from_serialized_xspace(
+        open(paths[0], "rb").read())
+    for plane in pd.planes:
+        if "TPU" not in plane.name:
+            continue
+        print(f"== plane {plane.name}")
+        for line in plane.lines:
+            evs = list(line.events)
+            tot = sum(e.duration_ns for e in evs)
+            print(f"  line '{line.name}': {len(evs)} events, "
+                  f"{tot/5/1e6:.3f} ms/step")
+        # categorize the synchronous op line via the HLO dump
+        hlo = open("/tmp/fused_step.hlo").read() \
+            if os.path.exists("/tmp/fused_step.hlo") else ""
+        cat_of = _categorize_hlo(hlo)
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            evs = list(line.events)
+            tot = sum(e.duration_ns for e in evs)
+            agg = defaultdict(lambda: [0.0, 0])
+            for ev in evs:
+                name = ev.name.split(" = ")[0]
+                agg[cat_of.get(name, _fallback_cat(name))][0] += \
+                    ev.duration_ns
+                agg[cat_of.get(name, _fallback_cat(name))][1] += 1
+            rows = sorted(agg.items(), key=lambda kv: -kv[1][0])
+            print(f"\n  -- time by op CATEGORY on '{line.name}' "
+                  f"({tot/5/1e6:.3f} ms/step, "
+                  f"{len(evs)//5} ops/step) --")
+            print(f"  {'ms/step':>8} {'%':>5} {'ops/step':>8}  category")
+            for cat, (ns, n) in rows:
+                print(f"  {ns/5/1e6:>8.2f} {100*ns/tot:>5.1f} "
+                      f"{n//5:>8d}  {cat}")
+            # also top individual ops with their category
+            agg2 = defaultdict(lambda: [0.0, 0])
+            for ev in evs:
+                name = ev.name.split(" = ")[0]
+                agg2[name][0] += ev.duration_ns
+                agg2[name][1] += 1
+            rows2 = sorted(agg2.items(), key=lambda kv: -kv[1][0])
+            print(f"\n  -- top individual ops --")
+            conv_desc = _conv_descriptions(hlo)
+            for name, (ns, n) in rows2[:25]:
+                print(f"  {ns/5/1e3:>9.1f}us {100*ns/tot:>5.1f}% "
+                      f"x{n//5:<3d} [{cat_of.get(name, '?')}] {name[:80]}")
+            # rank conv fusions with their conv config
+            print(f"\n  -- conv fusions by time (config from HLO) --")
+            shown = 0
+            for name, (ns, n) in rows2:
+                if cat_of.get(name) not in ("conv-fusion", "conv-bare"):
+                    continue
+                print(f"  {ns/5/1e3:>9.1f}us "
+                      f"{conv_desc.get(name, '?')[:130]}")
+                shown += 1
+                if shown >= 40:
+                    break
+
+
+def _conv_descriptions(hlo):
+    """fusion/instr name -> conv config string inside it."""
+    from hlo_breakdown import build_symtab, conv_flops
+    tab = build_symtab(hlo)
+    # computation -> conv desc
+    comp_desc = {}
+    cur = None
+    for line in hlo.splitlines():
+        m = re.match(r"^(%[\w.\-]+)\s+\([^)]*\)\s*->", line)
+        if m:
+            cur = m.group(1)
+            continue
+        if cur and line.startswith("}"):
+            cur = None
+            continue
+        if cur and "convolution(" in line:
+            r = conv_flops(line, tab)
+            if r:
+                fl, dt, od, ld, rd, dl, g, bg, win, src = r
+                comp_desc[cur] = (f"naive_gflop={fl/1e9:<7.1f} out={od} "
+                                  f"lhs={ld} kern={rd} dl={dl} win=[{win}]")
+    desc = {}
+    for line in hlo.splitlines():
+        name, kind = _parse_kind(line)
+        if not name:
+            continue
+        if kind == "fusion":
+            mc = re.search(r"calls=(%[\w.\-]+)", line)
+            if mc and mc.group(1) in comp_desc:
+                desc[name] = comp_desc[mc.group(1)]
+        elif kind == "convolution":
+            r = conv_flops(line, tab)
+            if r:
+                fl, dt, od, ld, rd, dl, g, bg, win, src = r
+                desc[name] = (f"naive_gflop={fl/1e9:<7.1f} out={od} "
+                              f"lhs={ld} kern={rd} dl={dl} win=[{win}]")
+    return desc
+
+
+def _fallback_cat(name):
+    n = name.lstrip("%")
+    for k in ("copy", "convolution", "fusion", "convert", "reduce",
+              "select_and_scatter", "transpose", "bitcast", "broadcast"):
+        if n.startswith(k):
+            return k
+    return "other"
+
+
+_KIND_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?(%[\w.\-]+)\s*=\s*(?:\([^)]*\)|\S+)\s+([\w\-]+)\(")
+
+
+def _parse_kind(line):
+    """'%x = bf16[1,2]{layout} fusion(...)' -> ('%x', 'fusion')"""
+    clean = re.sub(r"\{[^{}]*\}", "", line)
+    m = _KIND_RE.match(clean)
+    return (m.group(1), m.group(2)) if m else (None, None)
+
+
+def _categorize_hlo(hlo):
+    """Map %instr name -> category using fusion bodies in optimized HLO."""
+    # computation name -> set of op kinds inside
+    comp_ops = {}
+    cur = None
+    for line in hlo.splitlines():
+        m = re.match(r"^(%[\w.\-]+)\s+\([^)]*\)\s*->", line)
+        if m:
+            cur = m.group(1)
+            comp_ops[cur] = set()
+            continue
+        if cur and line.startswith("}"):
+            cur = None
+            continue
+        if cur:
+            _, kind = _parse_kind(line)
+            if kind:
+                comp_ops[cur].add(kind)
+    cat_of = {}
+    for line in hlo.splitlines():
+        name, kind = _parse_kind(line)
+        if not name:
+            continue
+        if kind == "fusion":
+            mc = re.search(r"calls=(%[\w.\-]+)", line)
+            ops = comp_ops.get(mc.group(1), set()) if mc else set()
+            if "convolution" in ops:
+                cat_of[name] = "conv-fusion"
+            elif "dot" in ops:
+                cat_of[name] = "dot-fusion"
+            elif "scatter" in ops:
+                cat_of[name] = "scatter-fusion"
+            elif "reduce" in ops or "reduce_window" in ops:
+                cat_of[name] = "reduce-fusion"
+            else:
+                cat_of[name] = "elementwise-fusion"
+        elif kind == "convolution":
+            cat_of[name] = "conv-bare"
+        else:
+            cat_of[name] = kind
+    return cat_of
+
+
+if __name__ == "__main__":
+    main()
