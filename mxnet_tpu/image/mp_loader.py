@@ -22,6 +22,16 @@ the fixed per-epoch batch count. Partial per-shard tail batches are padded
 by wraparound with the pad count reported on ``DataBatch.pad`` (the
 reference's round_batch behavior) so metrics can ignore padded records and
 no record is silently dropped.
+
+Shuffle scope (documented deviation): shards are a fixed round-robin
+split of the record index, so each batch mixes records from ONE worker's
+shard only — weaker than the reference's global shuffle. The shard split
+is stride-based (r::nworkers over the on-disk order), which decorrelates
+any on-disk grouping across shards; per-epoch within-shard shuffles then
+vary batch composition. Redistributing shards across persistent worker
+processes each epoch would serialize the whole key list through IPC per
+epoch for marginal mixing gain; use more workers (smaller shards) if
+batch-level mixing matters for your data.
 """
 from __future__ import annotations
 
@@ -110,7 +120,10 @@ def _worker(rank, path_imgrec, path_imgidx, keys, batch_size, data_shape,
                 idxs = order[b * batch_size:(b + 1) * batch_size]
                 pad = batch_size - len(idxs)
                 if pad:
-                    idxs = np.concatenate([idxs, order[:pad]])
+                    # wraparound pad; np.resize tiles when the whole
+                    # shard is smaller than one batch (tiny num_parts
+                    # partitions), so no slot row is left uninitialized
+                    idxs = np.concatenate([idxs, np.resize(order, pad)])
                 for i, k in enumerate(idxs):
                     header, raw = recordio.unpack(rec.read_idx(int(k)))
                     img = cv2.imdecode(np.frombuffer(raw, np.uint8),

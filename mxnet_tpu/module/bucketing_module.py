@@ -155,15 +155,18 @@ class BucketingModule(BaseModule):
         self._curr_bucket_key = self._default_bucket_key
         self._buckets[self._default_bucket_key] = module
 
-    def _borrow_optimizer(self, module):
-        """Point ``module`` at the default bucket's optimizer/updater so
-        every bucket steps ONE shared optimizer (reference:
-        module.borrow_optimizer in bucketing_module.py:306)."""
-        default = self._buckets[self._default_bucket_key]
-        module._optimizer = default._optimizer
-        module._updater = default._updater
-        module._kvstore = default._kvstore
-        module._update_on_kvstore = default._update_on_kvstore
+    def _borrow_optimizer(self, module, source=None):
+        """Point ``module`` at ``source``'s optimizer/updater (default:
+        the default bucket) so every bucket steps ONE shared optimizer
+        (reference: module.borrow_optimizer in bucketing_module.py:306).
+        init_optimizer passes the module it actually initialized —
+        _curr_module may not be the default bucket at that point."""
+        if source is None:
+            source = self._buckets[self._default_bucket_key]
+        module._optimizer = source._optimizer
+        module._updater = source._updater
+        module._kvstore = source._kvstore
+        module._update_on_kvstore = source._update_on_kvstore
         module.optimizer_initialized = True
 
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
@@ -206,8 +209,7 @@ class BucketingModule(BaseModule):
                                          force_init=force_init)
         for mod in self._buckets.values():
             if mod is not self._curr_module:
-                self._borrow_optimizer(mod)
-                mod.optimizer_initialized = True
+                self._borrow_optimizer(mod, source=self._curr_module)
         self.optimizer_initialized = True
 
     def prepare(self, data_batch, sparse_row_id_fn=None):

@@ -134,7 +134,25 @@ def conv_s2d_stem(data, weight, **kw):
     wastes most of the 128-deep contraction lanes, and the folded form
     quadruples the input-channel depth (the MLPerf ResNet TPU technique).
     """
+    # the rewrite below is derived specifically for kernel 7x7, stride 2,
+    # pad 3, no dilation/groups, and needs even H,W — reject anything
+    # else loudly instead of silently computing the wrong convolution
+    def _is(name, want):
+        v = kw.get(name)
+        return v is None or tuple(v) == want
+    if not (_is("kernel", (7, 7)) and _is("stride", (2, 2))
+            and _is("pad", (3, 3)) and _is("dilate", (1, 1))
+            and int(kw.get("num_group", 1)) == 1):
+        raise ValueError(
+            "conv_s2d_stem implements exactly Convolution(kernel=(7,7), "
+            f"stride=(2,2), pad=(3,3), no dilation/groups); got attrs "
+            f"{ {k: v for k, v in kw.items() if k in ('kernel', 'stride', 'pad', 'dilate', 'num_group')} }. "
+            "Use the plain Convolution op for other geometries.")
     B, C, H, W = data.shape
+    if H % 2 or W % 2:
+        raise ValueError(
+            f"conv_s2d_stem needs even spatial dims (space-to-depth "
+            f"block 2); got input {H}x{W}")
     O = weight.shape[0]
     xs = data.reshape(B, C, H // 2, 2, W // 2, 2).transpose(
         0, 1, 3, 5, 2, 4).reshape(B, C * 4, H // 2, W // 2)

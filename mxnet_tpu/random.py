@@ -26,6 +26,7 @@ def seed(seed_state: int, ctx="all"):
     with _lock:
         _key[0] = jax.random.PRNGKey(int(seed_state))
         _np_rng[0] = np.random.RandomState(int(seed_state))
+        _trace_fallback[0] = 0
 
 
 def numpy_rng():
@@ -67,14 +68,32 @@ def next_key():
             # ALL ops, even constant-input ones) would store a tracer
             _key[0] = np.array([0, 0], np.uint32)
         new, sub = jax.random.split(_key[0])
-        if isinstance(new, jax.core.Tracer):
+        # tracer detection: jax.core.Tracer when available (it is a
+        # deprecated alias that may move), else the tracers' _trace
+        # attribute — isinstance(x, jax.Array) can't distinguish (tracers
+        # register as jax.Array)
+        tracer_cls = getattr(jax.core, "Tracer", None)
+        is_tracer = isinstance(new, tracer_cls) if tracer_cls \
+            else hasattr(new, "_trace")
+        if is_tracer:
             # called under an unmanaged trace (e.g. eval_shape during
             # Symbol.infer_shape over an RNG op): NEVER store a tracer
             # into host RNG state — it would escape the trace and poison
             # every later caller. A host-side counter (plain int, safe to
-            # advance) keeps successive calls inside one trace distinct.
+            # advance) keeps successive calls inside one trace distinct;
+            # the concrete branch below folds it into the key afterwards
+            # so host state still advances past the in-trace keys.
             _trace_fallback[0] += 1
             return jax.random.fold_in(sub, _trace_fallback[0])
+        if _trace_fallback[0]:
+            # consume the trace salt by advancing the key through a
+            # DIFFERENT branch (new, not sub): in-trace callers got
+            # fold_in(sub, 1..n), so keys derived from fold_in(new, n)
+            # can never collide with or re-derive them
+            _key[0] = np.asarray(
+                jax.random.fold_in(new, _trace_fallback[0]))
+            _trace_fallback[0] = 0
+            new, sub = jax.random.split(_key[0])
         _key[0] = new
     return sub
 
