@@ -48,24 +48,23 @@ def synthetic_corpus(n_sentences=2000, vocab_size=200, seed=0):
 
 def sym_gen_factory(vocab_size, num_embed, num_hidden, num_layers,
                     batch_size):
-    from mxnet_tpu.ops.nn import rnn_param_size
-    nparams = rnn_param_size("lstm", num_layers, num_embed, num_hidden)
+    # the legacy cell API (reference: example/rnn/lstm_bucketing.py uses
+    # mx.rnn cells): ONE FusedRNNCell shared across buckets — every
+    # bucket's symbol reuses the same flat lstm_parameters variable
+    # forget_bias=0: the synthetic corpus is order-1 Markov — biasing
+    # the gates toward remembering only slows early convergence here
+    cell = mx.rnn.FusedRNNCell(num_hidden, num_layers=num_layers,
+                               mode="lstm", forget_bias=0.0,
+                               prefix="lstm_")
 
     def sym_gen(seq_len):
         data = mx.sym.Variable("data")
         label = mx.sym.Variable("softmax_label")
         embed = mx.sym.Embedding(data, input_dim=vocab_size,
                                  output_dim=num_embed, name="embed")
-        # fused RNN op wants (T, N, C) and the cuDNN-layout flat params
-        tnc = mx.sym.transpose(embed, axes=(1, 0, 2))
-        params = mx.sym.Variable("lstm_parameters", shape=(nparams,),
-                                 init="uniform")
-        h0 = mx.sym.zeros(shape=(num_layers, batch_size, num_hidden))
-        c0 = mx.sym.zeros(shape=(num_layers, batch_size, num_hidden))
-        out = mx.sym.RNN(tnc, params, h0, c0, state_size=num_hidden,
-                         num_layers=num_layers, mode="lstm", name="lstm")
-        ntc = mx.sym.transpose(out, axes=(1, 0, 2))
-        pred = mx.sym.Reshape(ntc, shape=(-1, num_hidden))
+        out, _ = cell.unroll(seq_len, embed, layout="NTC",
+                             merge_outputs=True)
+        pred = mx.sym.Reshape(out, shape=(-1, num_hidden))
         pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size,
                                      name="pred")
         label = mx.sym.Reshape(label, shape=(-1,))

@@ -250,10 +250,16 @@ class Executor:
                          for n in self.arg_names)
         aux_vals = tuple(self._place(n, self.aux_dict[n]._data)
                          for n in self.aux_names)
-        if self._monitor_callback is not None and self._monitor_all:
+        cb_active = getattr(self._monitor_callback, "active",
+                            None) if self._monitor_callback else None
+        monitor_now = self._monitor_callback is not None and \
+            (cb_active is None or cb_active())
+        if monitor_now and self._monitor_all:
             # interpreted pass capturing every op output for the Monitor
             # (reference: GraphExecutor ExecuteMonCallback :1445); slower
-            # than the jit path — monitoring is a debug mode there too
+            # than the jit path — monitoring is a debug mode there too,
+            # and an interval-based Monitor only activates it on its
+            # monitored batches (callback.active probe)
             amap = {n: v for n, v in zip(self.arg_names, arg_vals)}
             amap.update(zip(self.aux_names, aux_vals))
             internals = {}
@@ -268,7 +274,7 @@ class Executor:
                                               bool(is_train))
         self.outputs = [_wrap(o) for o in outs]
         self._apply_aux_updates(aux_updates)
-        if self._monitor_callback is not None and not self._monitor_all:
+        if monitor_now and not self._monitor_all:
             for name, o in zip(self.output_names, self.outputs):
                 self._monitor_callback(name, o)
         return self.outputs
@@ -387,6 +393,10 @@ class Executor:
         # would silently un-shard a multi-context Module
         new_exec._mesh = self._mesh
         new_exec._batch_args = set(self._batch_args)
+        # an installed Monitor survives the reshape (its callback would
+        # otherwise silently stop capturing)
+        new_exec._monitor_callback = self._monitor_callback
+        new_exec._monitor_all = self._monitor_all
         if self._mesh is not None:
             ndev = self._mesh.devices.size
             for name, s in zip(self.arg_names, arg_shapes):

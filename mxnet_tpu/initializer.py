@@ -339,6 +339,59 @@ class LSTMBias(Initializer):
 
 
 @register
+class FusedRNN(Initializer):
+    """Initialize the flat cuDNN-layout parameter vector of a
+    FusedRNNCell by unpacking it into per-gate views, applying the inner
+    (or global) initializer to each, and the forget-gate bias override
+    for LSTM (reference: initializer.py:676-726)."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            init = create(init)
+        super().__init__(
+            init=init.dumps() if init is not None else None,
+            num_hidden=num_hidden, num_layers=num_layers, mode=mode,
+            bidirectional=bidirectional, forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .rnn.rnn_cell import FusedRNNCell
+        cell = FusedRNNCell(self._num_hidden, self._num_layers,
+                            self._mode, self._bidirectional,
+                            forget_bias=self._forget_bias, prefix="")
+        flat = np.array(self._to_numpy(arr), copy=True).ravel()
+        views = cell._slice_weights(
+            flat, cell._num_input(flat.size), self._num_hidden)
+        gi = getattr(desc, "global_init", None) if isinstance(
+            desc, InitDesc) else None
+        for name, view in views.items():
+            # views alias `flat`; _set writes numpy views in place
+            sub_desc = InitDesc(name, global_init=gi)
+            if self._mode == "lstm" and name.endswith("_f_bias"):
+                view[:] = self._forget_bias
+            elif self._init is not None:
+                self._init(sub_desc, view)
+            elif gi is not None:
+                gi(sub_desc, view)
+            else:
+                Uniform(0.07)(sub_desc, view)
+        self._set(arr, flat.reshape(self._shape(arr)))
+
+    _init_default = _init_weight
+
+    @staticmethod
+    def _to_numpy(arr):
+        return arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(
+            getattr(arr, "_data", arr))
+
+
+@register
 class Load:
     """Init from a dict of arrays, falling back to ``default_init``
     (reference: initializer.py:287)."""

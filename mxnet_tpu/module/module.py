@@ -488,6 +488,15 @@ class Module(BaseModule):
                 n: (a._data if isinstance(a, nd.NDArray)
                     else jnp.asarray(a)) for n, a in feed.items()}
             self._exec.outputs = []  # stale until update() or get_outputs()
+            mon = getattr(self, "_monitor", None)
+            if mon is not None and getattr(mon, "activated", False):
+                # monitored batch: extra tapped fwd+bwd at pre-update
+                # params (observation only — the training step still
+                # runs fused)
+                if self._params_dirty:
+                    self._sync_params_from_devices()
+                self._exec.forward(is_train=True, **feed)
+                self._exec.backward()
             return
         if self._fused is not None and self._params_dirty:
             # eval/predict between fused steps: executor arrays are stale
@@ -527,6 +536,12 @@ class Module(BaseModule):
             from ..ndarray.ndarray import _wrap
             self._exec.outputs = [_wrap(o) for o in outs]
             self._fused_outs_live = True
+            mon = getattr(self, "_monitor", None)
+            if mon is not None and getattr(mon, "activated", False):
+                # Monitor.toc reads the eager executor's arg arrays after
+                # update (reference: monitor.py toc) — give it the
+                # POST-step weights, not the stale pre-step copies
+                self._sync_params_from_devices()
             return
         if self._kvstore is not None and self._update_on_kvstore:
             for i, name in enumerate(self._param_names):
@@ -588,8 +603,14 @@ class Module(BaseModule):
             dict(zip(self._output_names, self.get_outputs())))
 
     def install_monitor(self, mon):
+        """Attach a Monitor WITHOUT leaving the fused regime: batches
+        inside the monitor interval additionally run the tapped
+        interpreted forward on the eager executor (pre-update params,
+        the same activations the reference's callback sees —
+        monitor.py:33 is interval-based there too); every other batch
+        stays on the compiled fused step."""
         assert self.binded
-        self._degrade_fused("install_monitor")
+        self._monitor = mon
         mon.install(self._exec)
 
     # -- optimizer state io ----------------------------------------------------

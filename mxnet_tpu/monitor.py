@@ -44,6 +44,10 @@ class Monitor:
                 return
             self.queue.append((self.step, name, self.stat_func(array)))
 
+        # executors probe this to skip the interpreted capture pass on
+        # batches outside the monitor interval (executor.py forward) —
+        # the fused Module stays on its compiled step between taps
+        stat_helper.active = lambda: self.activated
         self.stat_helper = stat_helper
 
     def install(self, exe, monitor_all=True):

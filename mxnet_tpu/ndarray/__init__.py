@@ -110,6 +110,17 @@ for _name in list(_OPS):
         setattr(_mod, _name, _make_op_func(_OPS[_name]))
 
 
+def cast_storage(data, stype="default", **kwargs):
+    """Eager storage cast routes to the real sparse machinery
+    (reference: cast_storage op, src/operator/tensor/cast_storage.cc);
+    the graph-op form (ops/surface.py) is dense-identity and raises on
+    sparse targets."""
+    if stype in (None, "default"):
+        return data.tostype("default") if hasattr(data, "tostype") \
+            else data
+    return data.tostype(stype)
+
+
 # -- creation functions with MXNet signatures --------------------------------
 def zeros(shape, ctx: Optional[Context] = None, dtype="float32"):
     data = jnp.zeros(shape if isinstance(shape, tuple) else

@@ -427,6 +427,20 @@ def rnn_unpack_params(params, mode, num_layers, input_size, state_size,
     return layers
 
 
+@register_op("_rnn_zero_state")
+def rnn_zero_state(data, state_size=0, num=0, batch_axis=0, **kw):
+    """Zero initial RNN state derived from a data symbol's batch dim —
+    lets cell.unroll(begin_state=None) work at graph-build time without
+    a concrete batch size (the reference creates shape-(0,...) zeros and
+    lets InferShape fill them in; here shapes flow through eval_shape).
+    data (T,N,C) + num>0 -> zeros (num, N, state_size); otherwise
+    zeros (data.shape[batch_axis], state_size) — the caller passes the
+    layout's batch axis (NTC->0, TNC->1)."""
+    n = data.shape[1] if num else data.shape[int(batch_axis)]
+    shape = (num, n, state_size) if num else (n, state_size)
+    return jnp.zeros(shape, data.dtype)
+
+
 def rnn_param_size(mode, num_layers, input_size, state_size, bidirectional=False):
     ngates = _rnn_gate_count(mode)
     dirs = 2 if bidirectional else 1

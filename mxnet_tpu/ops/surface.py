@@ -118,7 +118,16 @@ def hypot_scalar(data, scalar=0.0, **kw):
 def cast_storage(data, stype="default", **kw):
     """Storage conversion is an NDArray-level concern here (ndarray.sparse
     tostype); as a graph op on dense values it is the identity, matching
-    the dense->dense case of src/operator/tensor/cast_storage.cc."""
+    the dense->dense case of src/operator/tensor/cast_storage.cc. A
+    non-default target stype inside a compiled graph cannot produce a
+    sparse value (XLA programs are dense) — raise instead of silently
+    returning dense (the eager nd.cast_storage routes to tostype)."""
+    if stype not in (None, "default"):
+        raise ValueError(
+            f"cast_storage(stype={stype!r}) inside a compiled graph "
+            "would silently produce a dense result; sparse storage "
+            "conversion is NDArray-level — use .tostype() / "
+            "nd.cast_storage eagerly (ndarray/sparse.py)")
     return data
 
 

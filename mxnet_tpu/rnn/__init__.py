@@ -1,17 +1,21 @@
 """Legacy symbolic RNN namespace (reference: python/mxnet/rnn/).
 
-The cell zoo lives in ``mxnet_tpu.gluon.rnn`` (the reference's legacy
-symbolic cells map 1:1 onto the gluon cells; fused = gluon.rnn.LSTM). This
-namespace keeps the bucketing data iterator and aliases for scripts written
-against ``mx.rnn``.
+``rnn_cell`` holds the symbolic cell API the reference's bucketing and
+speech examples are written against — including ``FusedRNNCell`` (the
+``sym.RNN`` fused kernel wrapper) with ``unfuse()`` and flat-vector
+weight interop. Gluon-style recurrent BLOCKS (incl. the conv cells,
+Zoneout, Residual) remain importable here for convenience under their
+gluon names.
 """
 from .io import BucketSentenceIter
-from ..gluon.rnn import (RNNCell, LSTMCell, GRUCell, SequentialRNNCell,
-                         DropoutCell, ZoneoutCell, ResidualCell,
-                         BidirectionalCell, ConvRNNCell, ConvLSTMCell,
-                         ConvGRUCell)
+from .rnn_cell import (RNNParams, BaseRNNCell, FusedRNNCell, RNNCell,
+                       LSTMCell, GRUCell, SequentialRNNCell, DropoutCell,
+                       BidirectionalCell)
+from ..gluon.rnn import (ZoneoutCell, ResidualCell, ConvRNNCell,
+                         ConvLSTMCell, ConvGRUCell)
 
-__all__ = ["BucketSentenceIter", "RNNCell", "LSTMCell", "GRUCell",
+__all__ = ["BucketSentenceIter", "RNNParams", "BaseRNNCell",
+           "FusedRNNCell", "RNNCell", "LSTMCell", "GRUCell",
            "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
            "ResidualCell", "BidirectionalCell", "ConvRNNCell",
            "ConvLSTMCell", "ConvGRUCell"]

@@ -21,6 +21,7 @@ OP_INPUTS = {
     "InstanceNorm": (["data", "gamma", "beta"], []),
     "Embedding": (["data", "weight"], []),
     "RNN": (["data", "parameters", "state", "state_cell"], []),
+    "_rnn_zero_state": (["data"], []),
     "SoftmaxOutput": (["data", "label"], []),
     "Softmax": (["data", "label"], []),
     "LinearRegressionOutput": (["data", "label"], []),

@@ -654,6 +654,18 @@ def _hint_param_shapes(node, in_shapes, attrs):
     elif node.op in ("LinearRegressionOutput", "LogisticRegressionOutput",
                      "MAERegressionOutput"):
         want = {"label": tuple(data_shape)}
+    elif node.op == "RNN":
+        # flat cuDNN-layout parameter vector + (L*dirs, N, H) states from
+        # the (T, N, C) data shape (reference: rnn-inl.h GetRnnParamSize)
+        from ..ops.nn import rnn_param_size
+        h = int(attrs.get("state_size"))
+        layers = int(attrs.get("num_layers", 1))
+        bi = bool(attrs.get("bidirectional", False))
+        mode = attrs.get("mode", "lstm")
+        dirs = 2 if bi else 1
+        n = rnn_param_size(mode, layers, data_shape[2], h, bi)
+        st = (layers * dirs, data_shape[1], h)
+        want = {"parameters": (n,), "state": st, "state_cell": st}
     else:
         return None
     if names:

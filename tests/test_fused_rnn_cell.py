@@ -1,0 +1,129 @@
+"""Legacy mx.rnn.FusedRNNCell surface (reference: rnn/rnn_cell.py:536).
+
+Covers: unroll through the fused sym.RNN op, unfuse() into per-layer
+cells, flat-vector <-> per-gate weight interop in both directions, and
+the FusedRNN initializer (reference: initializer.py:676)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _init_fused(ex, out_sym):
+    init = mx.init.Xavier()
+    for name, arr in ex.arg_dict.items():
+        if name == "data":
+            continue
+        desc = mx.init.InitDesc(
+            name, attrs=out_sym.attr_dict().get(name, {}),
+            global_init=init)
+        init(desc, arr)
+
+
+@pytest.mark.parametrize("mode", ["lstm", "gru", "rnn_tanh"])
+def test_fused_unfused_output_parity(mode):
+    T, N, C, H, L = 5, 3, 4, 6, 2
+    mx.random.seed(0)
+    fused = mx.rnn.FusedRNNCell(H, num_layers=L, mode=mode,
+                                prefix=f"{mode}_")
+    data = mx.sym.Variable("data")
+    out_f, _ = fused.unroll(T, data, layout="NTC", merge_outputs=True)
+    ex = out_f.simple_bind(mx.cpu(), data=(N, T, C))
+    rng = np.random.RandomState(0)
+    x = rng.randn(N, T, C).astype(np.float32)
+    _init_fused(ex, out_f)
+    yf = ex.forward(data=x)[0].asnumpy()
+    assert yf.shape == (N, T, H)
+
+    stack = fused.unfuse()
+    out_u, _ = stack.unroll(T, data, layout="NTC", merge_outputs=True)
+    exu = out_u.simple_bind(mx.cpu(), data=(N, T, C))
+    pname = f"{mode}_parameters"
+    ua = stack.pack_weights(fused.unpack_weights(
+        {pname: ex.arg_dict[pname]}))
+    for name, arr in exu.arg_dict.items():
+        if name == "data":
+            continue
+        arr[:] = ua[name].asnumpy()
+    yu = exu.forward(data=x)[0].asnumpy()
+    # tolerance: the two programs order their matmuls differently and
+    # this CPU backend's eager/loop matmuls run at reduced precision
+    np.testing.assert_allclose(yf, yu, rtol=2e-2, atol=2e-3)
+
+
+def test_pack_unpack_roundtrip_exact():
+    H, L, C = 6, 2, 4
+    fused = mx.rnn.FusedRNNCell(H, num_layers=L, mode="lstm",
+                                prefix="lstm_")
+    from mxnet_tpu.ops.nn import rnn_param_size
+    size = rnn_param_size("lstm", L, C, H)
+    vec = np.random.RandomState(0).randn(size).astype(np.float32)
+    un = fused.unpack_weights({"lstm_parameters": mx.nd.array(vec)})
+    assert "lstm_parameters" not in un
+    assert f"lstm_l0_i2h_i_weight" in un
+    assert un["lstm_l0_i2h_i_weight"].shape == (H, C)
+    assert un["lstm_l1_i2h_f_weight"].shape == (H, H)
+    pk = fused.pack_weights(un)
+    np.testing.assert_array_equal(pk["lstm_parameters"].asnumpy(), vec)
+
+
+def test_unfused_stack_structure_and_gate_split():
+    fused = mx.rnn.FusedRNNCell(5, num_layers=3, mode="gru",
+                                dropout=0.3, prefix="g_")
+    stack = fused.unfuse()
+    kinds = [type(c).__name__ for c in stack._cells]
+    assert kinds == ["GRUCell", "DropoutCell", "GRUCell", "DropoutCell",
+                     "GRUCell"]
+    # per-cell 3H fused FC <-> per-gate roundtrip
+    cell = stack._cells[0]
+    w = np.random.RandomState(1).randn(15, 4).astype(np.float32)
+    un = cell.unpack_weights({"g_l0_i2h_weight": mx.nd.array(w)})
+    assert un["g_l0_i2h_r_weight"].shape == (5, 4)
+    pk = cell.pack_weights(un)
+    np.testing.assert_array_equal(pk["g_l0_i2h_weight"].asnumpy(), w)
+
+
+def test_bidirectional_fused_shapes():
+    T, N, C, H = 4, 2, 3, 5
+    fused = mx.rnn.FusedRNNCell(H, num_layers=2, mode="lstm",
+                                bidirectional=True, prefix="bi_")
+    data = mx.sym.Variable("data")
+    out, _ = fused.unroll(T, data, layout="NTC", merge_outputs=True)
+    ex = out.simple_bind(mx.cpu(), data=(N, T, C))
+    _init_fused(ex, out)
+    y = ex.forward(data=np.zeros((N, T, C), np.float32))[0]
+    assert y.shape == (N, T, 2 * H)
+
+
+def test_fused_rnn_initializer_forget_bias():
+    """FusedRNN initializer writes forget-gate biases (reference:
+    initializer.py:721 custom f-bias) into the flat vector."""
+    H, L, C = 4, 1, 3
+    from mxnet_tpu.ops.nn import rnn_param_size
+    size = rnn_param_size("lstm", L, C, H)
+    arr = mx.nd.zeros((size,))
+    init = mx.init.FusedRNN(mx.init.Zero(), H, L, "lstm",
+                            forget_bias=2.5)
+    init(mx.init.InitDesc("lstm_parameters"), arr)
+    cell = mx.rnn.FusedRNNCell(H, num_layers=L, mode="lstm", prefix="")
+    un = cell.unpack_weights({"parameters": arr})
+    np.testing.assert_allclose(un["l0_i2h_f_bias"].asnumpy(), 2.5)
+    np.testing.assert_allclose(un["l0_h2h_f_bias"].asnumpy(), 2.5)
+    np.testing.assert_allclose(un["l0_i2h_i_bias"].asnumpy(), 0.0)
+    np.testing.assert_allclose(un["l0_i2h_i_weight"].asnumpy(), 0.0)
+
+
+def test_get_next_state():
+    T, N, C, H = 3, 2, 4, 5
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm",
+                                get_next_state=True, prefix="s_")
+    data = mx.sym.Variable("data")
+    out, states = fused.unroll(T, data, layout="TNC",
+                               merge_outputs=True)
+    assert len(states) == 2
+    grp = mx.sym.Group([out] + states)
+    ex = grp.simple_bind(mx.cpu(), data=(T, N, C))
+    _init_fused(ex, grp)
+    outs = ex.forward(data=np.zeros((T, N, C), np.float32))
+    assert outs[0].shape == (T, N, H)
+    assert outs[1].shape == (1, N, H) and outs[2].shape == (1, N, H)
