@@ -69,6 +69,40 @@ def _register_implicit_losses():
     })
 
 
+def collect_loss_specs(sym):
+    """(output_index, head node, parsed attrs) for every implicit-loss
+    head (SoftmaxOutput & co — reference: src/operator/softmax_output.cc).
+    Shared by the jitted, segmented, and fused executors."""
+    if not _IMPLICIT_LOSS:
+        _register_implicit_losses()
+    from .ops.registry import parse_attr
+    specs = []
+    for i, h in enumerate(sym._output_symbols()):
+        node = h._node
+        if node.op in _IMPLICIT_LOSS:
+            attrs = {k: parse_attr(v) for k, v in node.attrs.items()
+                     if not k.startswith("__")}
+            specs.append((i, node, attrs))
+    return specs
+
+
+def total_implicit_loss(loss_specs, head_inputs, outs, head_grads):
+    """Scalar training loss: each implicit head's loss over its INPUT
+    values plus sum(out * head_grad) for explicit heads — the quantity
+    whose gradient is the reference backward."""
+    import jax.numpy as jnp
+    total = jnp.zeros((), jnp.float32)
+    implicit = {i for i, _, _ in loss_specs}
+    for (i, node, attrs), ins in zip(loss_specs, head_inputs):
+        total = total + _IMPLICIT_LOSS[node.op](
+            *ins, **attrs).astype(jnp.float32)
+    for i, o in enumerate(outs):
+        if i not in implicit and head_grads is not None and \
+                head_grads[i] is not None:
+            total = total + jnp.sum(o * head_grads[i])
+    return total
+
+
 def build_graph_fns(sym, device_map=None):
     """Pure forward / forward-with-implicit-loss functions for a symbol.
 
@@ -102,39 +136,27 @@ def build_graph_fns(sym, device_map=None):
                                                device_map=device_map)
         return tuple(outs), aux_updates
 
-    heads = sym._output_symbols()
-    loss_specs = []
-    for i, h in enumerate(heads):
-        node = h._node
-        if node.op in _IMPLICIT_LOSS:
-            from .ops.registry import parse_attr
-            attrs = {k: parse_attr(v) for k, v in node.attrs.items()
-                     if not k.startswith("__")}
-            loss_specs.append((i, node, attrs))
+    loss_specs = collect_loss_specs(sym)
 
     def fwd_loss(arg_vals, aux_vals, head_grads, key):
-        import jax.numpy as jnp
         amap = dict(zip(arg_names, arg_vals))
         amap.update(zip(aux_names, aux_vals))
         outs, aux_updates = sym.eval_arrays_ex(amap, training=True,
                                                rng_key=key,
                                                device_map=device_map)
-        total = jnp.zeros((), jnp.float32)
-        implicit = {i for i, _, _ in loss_specs}
+        # recompute each head's loss from the head node's *inputs* (XLA
+        # CSE dedups against the forward eval)
+        head_inputs = []
         for i, node, attrs in loss_specs:
-            # recompute the loss from the head node's *inputs* (XLA CSE
-            # dedups against the forward eval)
             ins = []
             for p, oi in node.inputs:
                 sub = type(sym)(p, oi)
                 ins.append(sub.eval_arrays(amap, training=True,
                                            rng_key=key,
                                            device_map=device_map)[0])
-            total = total + _IMPLICIT_LOSS[node.op](*ins, **attrs)
-        for i, o in enumerate(outs):
-            if i not in implicit and head_grads is not None and \
-                    head_grads[i] is not None:
-                total = total + jnp.sum(o * head_grads[i])
+            head_inputs.append(ins)
+        total = total_implicit_loss(loss_specs, head_inputs, outs,
+                                    head_grads)
         return total, (tuple(outs), aux_updates)
 
     return fwd, fwd_loss, loss_specs
@@ -195,21 +217,55 @@ class Executor:
         import jax
 
         if self._group2ctx:
-            # model parallelism by placement: run the graph EAGERLY so
-            # each op dispatches to the device its data lives on, with
-            # device_put at group boundaries (the reference's
-            # _CrossDeviceCopy, graph_executor.cc:406). jit would pin the
-            # whole program to one device, so this path stays unjitted;
-            # JAX's async dispatch still pipelines the per-op kernels,
-            # and grad traces straight through the transfers.
+            # model parallelism by placement: the graph is partitioned at
+            # ctx-group boundaries into per-device SEGMENTS, each jitted
+            # as one XLA program pinned to its device (via committed
+            # inputs), with device_put transfers between segments — the
+            # compiled analog of the reference's per-device plan +
+            # _CrossDeviceCopy (graph_executor.cc:406). The old fallback
+            # dispatched every op eagerly. The Monitor capture pass
+            # (eval_arrays_ex) still walks eagerly with device_map.
+            import jax.numpy as jnp
             default_dev = self._ctx.jax_device if self._ctx is not None \
                 else None
             dmap = self._symbol.build_device_map(self._group2ctx,
                                                  default_dev)
             self._device_map = dmap
-            fwd, fwd_loss, loss_specs = build_graph_fns(self._symbol,
-                                                        device_map=dmap)
+            sym = self._symbol
+            arg_names = self.arg_names
+            aux_names = self.aux_names
+            loss_specs = collect_loss_specs(sym)
+            extra = [[(p, oi) for p, oi in node.inputs]
+                     for _i, node, _a in loss_specs]
+            flat_extra = [k for ins in extra for k in ins]
+            plan = sym.build_segment_plan(dmap, extra_outputs=flat_extra)
             self._loss_specs = loss_specs
+            self._segment_plan = plan
+            n_outs = len(sym._output_symbols())
+
+            def fwd(arg_vals, aux_vals, key, training):
+                amap = dict(zip(arg_names, arg_vals))
+                amap.update(zip(aux_names, aux_vals))
+                vals, aux_updates = sym.eval_segmented(
+                    plan, amap, training=training, rng_key=key)
+                return tuple(vals[:n_outs]), aux_updates
+
+            def fwd_loss(arg_vals, aux_vals, head_grads, key):
+                amap = dict(zip(arg_names, arg_vals))
+                amap.update(zip(aux_names, aux_vals))
+                vals, aux_updates = sym.eval_segmented(
+                    plan, amap, training=True, rng_key=key)
+                outs = vals[:n_outs]
+                # the head-input values ride along as extra plan outputs
+                head_inputs = []
+                p = n_outs
+                for ins in extra:
+                    head_inputs.append(vals[p:p + len(ins)])
+                    p += len(ins)
+                total = total_implicit_loss(loss_specs, head_inputs,
+                                            outs, head_grads)
+                return total, (tuple(outs), aux_updates)
+
             self._fwd_jit = fwd
             self._fwd_loss_grad = jax.grad(fwd_loss, argnums=0,
                                            has_aux=True)

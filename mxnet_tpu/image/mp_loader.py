@@ -78,17 +78,50 @@ def _fast_augment(img, out_hw, rand_crop, rand_mirror, resize, rng,
     return img
 
 
+def _native_decoder(path_imgrec, idx_keys, interp, c):
+    """(lib, handle, key->position map) for the in-native decode path
+    (native/recordio.cc rio_decode_batch), or None when unavailable /
+    not applicable (non-RGB, non-linear interp)."""
+    import cv2
+    if c != 3 or interp != cv2.INTER_LINEAR:
+        return None
+    if os.environ.get("MXNET_TPU_NATIVE_DECODE", "1") == "0":
+        return None
+    try:
+        from .. import native as native_mod
+        lib = native_mod.get_lib()
+        if lib is None or not hasattr(lib, "rio_decode_batch"):
+            return None
+        h = lib.rio_open(path_imgrec.encode())
+        if not h:
+            return None
+        n = lib.rio_count(h)
+        off2pos = {int(lib.rio_record_offset(h, p)): p for p in range(n)}
+        key2pos = {}
+        for k, off in idx_keys.items():
+            p = off2pos.get(int(off))
+            if p is None:
+                lib.rio_close(h)
+                return None
+            key2pos[int(k)] = p
+        return lib, h, key2pos
+    except Exception:
+        return None
+
+
 def _worker(rank, path_imgrec, path_imgidx, keys, batch_size, data_shape,
             label_width, shuffle, seed, rand_crop, rand_mirror, resize,
             mean, std, out_dtype, shm_name, lbl_shm_name, nslots,
-            free_q, ready_q, interp):
+            free_q, ready_q, interp, fast_decode=False):
     """Worker main: decode+augment its shard into shared-memory slots."""
     # never let a stray jax use in a child grab the TPU the parent owns
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import ctypes
     import cv2
     cv2.setNumThreads(0)  # one process = one core; don't oversubscribe
     c, oh, ow = data_shape
     rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+    native = _native_decoder(path_imgrec, rec.idx, interp, c)
     shm = shared_memory.SharedMemory(name=shm_name)
     lbl_shm = shared_memory.SharedMemory(name=lbl_shm_name)
     slot_shape = (nslots, batch_size, c, oh, ow)
@@ -124,6 +157,51 @@ def _worker(rank, path_imgrec, path_imgidx, keys, batch_size, data_shape,
                     # shard is smaller than one batch (tiny num_parts
                     # partitions), so no slot row is left uninitialized
                     idxs = np.concatenate([idxs, np.resize(order, pad)])
+                if native is not None:
+                    # whole-batch decode+augment inside the native
+                    # library (iter_image_recordio_2.cc analog)
+                    lib, nh, key2pos = native
+                    pos = np.array([key2pos[int(k)] for k in idxs],
+                                   np.int64)
+                    seeds = rng.randint(
+                        1, 2 ** 62, size=len(idxs)).astype(np.uint64)
+                    hwc = np.empty((len(idxs), oh, ow, 3), np.uint8)
+                    rc = lib.rio_decode_batch(
+                        nh, pos.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_int64)),
+                        len(idxs), oh, ow, int(resize or 0),
+                        int(bool(rand_crop)), int(bool(rand_mirror)),
+                        int(bool(fast_decode)),
+                        seeds.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_uint64)),
+                        hwc.ctypes.data_as(ctypes.c_void_p), 1)
+                    if rc != 0:
+                        # not JPEG (e.g. PNG-packed records) or a
+                        # corrupt stream: drop to the cv2 path for the
+                        # rest of the run
+                        native = None
+                    else:
+                        batch = hwc.transpose(0, 3, 1, 2)
+                        if normalize:
+                            batch = batch.astype(np.float32)
+                            if mean_a is not None:
+                                batch = batch - mean_a.reshape(
+                                    1, -1, 1, 1)
+                            if std_a is not None:
+                                batch = batch / std_a.reshape(
+                                    1, -1, 1, 1)
+                        data_buf[slot] = batch
+                        labs = np.zeros((len(idxs), label_width),
+                                        np.float32)
+                        for i, p in enumerate(pos):
+                            lib.rio_record_label(
+                                nh, int(p),
+                                labs[i].ctypes.data_as(
+                                    ctypes.POINTER(ctypes.c_float)),
+                                label_width)
+                        lbl_buf[slot, :len(idxs)] = labs
+                        ready_q.put(("ok", rank, slot, epoch, pad))
+                        continue
                 for i, k in enumerate(idxs):
                     header, raw = recordio.unpack(rec.read_idx(int(k)))
                     img = cv2.imdecode(np.frombuffer(raw, np.uint8),
@@ -176,7 +254,7 @@ class MPImageRecordIter(DataIter):
                  mean=None, std=None, dtype="float32", num_parts=1,
                  part_index=0, data_name="data",
                  label_name="softmax_label", path_imgidx=None,
-                 inter_method=1, as_numpy=False):
+                 inter_method=1, as_numpy=False, fast_decode=False):
         super().__init__(batch_size)
         if path_imgidx is None:
             path_imgidx = os.path.splitext(path_imgrec)[0] + ".idx"
@@ -272,7 +350,7 @@ class MPImageRecordIter(DataIter):
                           shuffle, seed, rand_crop, rand_mirror, resize,
                           mean, std, self._dtype, self._shm.name,
                           self._lbl_shm.name, nslots, self._free_qs[r],
-                          self._ready_q, inter_method),
+                          self._ready_q, inter_method, fast_decode),
                     daemon=True)
                 p.start()
                 self._procs.append(p)

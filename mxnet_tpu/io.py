@@ -453,7 +453,7 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
     _idx = kwargs.get("path_imgidx") or \
         _os.path.splitext(path_imgrec)[0] + ".idx"
     _mp_keys = ("dtype", "seed", "path_imgidx", "inter_method",
-                "as_numpy")
+                "as_numpy", "fast_decode")
     _mp_unsupported = set(kwargs) - set(_mp_keys)
     if preprocess_threads and _os.path.isfile(_idx) and not _mp_unsupported:
         from .image.mp_loader import MPImageRecordIter
@@ -471,7 +471,8 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
         import warnings
         # mp-only knobs have no ImageIter equivalent: strip them so they
         # aren't silently swallowed, and say so
-        dropped = sorted(set(kwargs) & {"as_numpy", "seed"})
+        dropped = sorted(set(kwargs) & {"as_numpy", "seed",
+                                        "fast_decode"})
         for k in dropped:
             kwargs.pop(k)
         extra = f"; dropping mp-only kwargs {dropped}" if dropped else ""

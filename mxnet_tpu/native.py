@@ -39,7 +39,14 @@ def get_lib():
             return _lib
         _tried = True
         try:
-            if not os.path.exists(_LIB_PATH):
+            src = os.path.join(_NATIVE_DIR, "recordio.cc")
+            # rebuild BEFORE the first dlopen when the source is newer —
+            # relinking an already-mapped .so truncates live code pages,
+            # and a second CDLL on the same inode returns the stale
+            # handle anyway
+            if not os.path.exists(_LIB_PATH) or (
+                    os.path.exists(src) and
+                    os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)):
                 _build()
             lib = ctypes.CDLL(_LIB_PATH)
         except Exception:
@@ -67,6 +74,25 @@ def get_lib():
         lib.rio_prefetch_next.argtypes = [ctypes.c_void_p]
         lib.rio_prefetch_stop.argtypes = [ctypes.c_void_p]
         lib.rio_close.argtypes = [ctypes.c_void_p]
+        # in-native JPEG decode + augment (iter_image_recordio_2.cc:727
+        # analog); absent in pre-r5 builds — probe before binding
+        if hasattr(lib, "rio_decode_batch"):
+            lib.rio_decode_record.restype = ctypes.c_int
+            lib.rio_decode_record.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_uint64, ctypes.c_void_p]
+            lib.rio_decode_batch.restype = ctypes.c_int
+            lib.rio_decode_batch.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_void_p,
+                ctypes.c_int]
+            lib.rio_record_label.restype = ctypes.c_int
+            lib.rio_record_label.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_float), ctypes.c_int]
         _lib = lib
         return _lib
 

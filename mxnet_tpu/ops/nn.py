@@ -365,8 +365,12 @@ def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **kw):
     sq = jnp.square(data)
     half = nsize // 2
     padded = jnp.pad(sq, ((0, 0), (half, half)) + ((0, 0),) * (data.ndim - 2))
+    # NOTE: init must be a python scalar — an array init stops JAX from
+    # selecting the differentiable reduce_window_sum primitive, and the
+    # generic reduce_window has no reverse-mode rule (found by the
+    # registry gradient sweep, tests/test_op_gradients.py)
     window = jax.lax.reduce_window(
-        padded, jnp.asarray(0, data.dtype), jax.lax.add,
+        padded, 0.0, jax.lax.add,
         (1, nsize) + (1,) * (data.ndim - 2), (1,) * data.ndim,
         [(0, 0)] * data.ndim)
     return data / jnp.power(knorm + alpha / nsize * window, beta)

@@ -385,6 +385,176 @@ class Symbol:
                    for s in self._output_symbols()]
         return outputs, aux_updates
 
+    # -- segmented (jit-per-device) evaluation --------------------------------
+    def build_segment_plan(self, device_map, extra_outputs=()):
+        """Partition the graph into contiguous same-device segments for
+        the group2ctx Executor: each segment jit-compiles as one XLA
+        program pinned (by input placement) to its device, with
+        ``device_put`` transfers only at segment boundaries — the
+        compiled analog of the reference's per-device execution plan +
+        _CrossDeviceCopy (graph_executor.cc:406). The old fallback ran
+        every op eagerly (per-op dispatch).
+
+        ``extra_outputs``: additional (node, idx) values to surface
+        (the implicit-loss head inputs, so fwd_loss composes without a
+        second graph walk). Returns an opaque plan consumed by
+        ``eval_segmented``."""
+        op_nodes = [n for n in self._topo_nodes() if n.op is not None]
+        segs = []
+        cur_dev, cur = object(), None
+        for n in op_nodes:
+            dev = device_map.get(n.name)
+            if cur is None or dev is not cur_dev:
+                cur = []
+                segs.append((dev, cur))
+                cur_dev = dev
+            cur.append(n)
+        node_seg = {}
+        for si, (_d, ns) in enumerate(segs):
+            for n in ns:
+                node_seg[id(n)] = si
+        want = [(s._node, s._out_index) for s in self._output_symbols()]
+        want += [(n, i) for n, i in extra_outputs]
+        needed = {}          # (id(node), idx) -> (node, idx)
+        for n, i in want:
+            if n.op is not None:
+                needed[(id(n), i)] = (n, i)
+        # one pass: last segment consuming each value (topo order makes
+        # the final assignment the max) — keeps the plan O(edges)
+        last_consumer = {}
+        for si, (_d, ns) in enumerate(segs):
+            for m in ns:
+                for q, j in m.inputs:
+                    last_consumer[(id(q), j)] = si
+        plan_segs = []
+        for si, (dev, ns) in enumerate(segs):
+            in_keys, out_keys, var_names = [], [], []
+            seen_in = set()
+            inside = {id(n) for n in ns}
+            for n in ns:
+                for p, i in n.inputs:
+                    k = (id(p), i)
+                    if p.op is None:
+                        if p.name not in var_names:
+                            var_names.append(p.name)
+                    elif id(p) not in inside and k not in seen_in:
+                        seen_in.add(k)
+                        in_keys.append(k)
+                for i in range(max(n.num_outputs, 1)):
+                    k = (id(n), i)
+                    if last_consumer.get(k, -1) > si or k in needed:
+                        out_keys.append(k)
+            plan_segs.append({"dev": dev, "nodes": ns,
+                              "in_keys": in_keys, "out_keys": out_keys,
+                              "var_names": var_names, "jit": {}})
+        return {"segs": plan_segs, "want": want}
+
+    def _make_segment_fn(self, seg, training):
+        """(fn, aux_names): pure fn(invals, varvals, key) ->
+        (outvals, aux_update_vals ordered by aux_names)."""
+        import jax
+        from ..ops.registry import get_op
+
+        nodes = seg["nodes"]
+        in_keys = list(seg["in_keys"])
+        out_keys = list(seg["out_keys"])
+        var_names = list(seg["var_names"])
+        aux_names = ()
+        if training:
+            names = set()
+            for n in nodes:
+                if n.op not in ("BatchNorm", "BatchNorm_v1"):
+                    continue
+                attrs = {k: parse_attr(v) for k, v in n.attrs.items()
+                         if not k.startswith("__")}
+                if attrs.get("use_global_stats"):
+                    continue
+                for pos in (3, 4):
+                    p, _i = n.inputs[pos]
+                    if p.op is None:
+                        names.add(p.name)
+            aux_names = tuple(sorted(names))
+
+        def fn(invals, varvals, key):
+            env = dict(zip(in_keys, invals))
+            vmap = dict(zip(var_names, varvals))
+            aux_up = {}
+            for node in nodes:
+                ins = []
+                for p, i in node.inputs:
+                    ins.append(vmap[p.name] if p.op is None
+                               else env[(id(p), i)])
+                attrs = {k: parse_attr(v) for k, v in node.attrs.items()
+                         if not k.startswith("__")}
+                opdef = get_op(node.op)
+                if node.op in ("BatchNorm", "BatchNorm_v1", "Dropout",
+                               "RNN"):
+                    attrs["training"] = training
+                if node.op in ("Dropout", "RNN") and training:
+                    attrs["key"] = jax.random.fold_in(
+                        key, node.uid % (2 ** 31))
+                innames = node.attrs.get("__input_names__")
+                if innames:
+                    res = opdef.fn(**dict(zip(parse_attr(innames), ins)),
+                                   **attrs)
+                else:
+                    res = opdef.fn(*ins, **attrs)
+                outs = res if isinstance(res, tuple) else (res,)
+                for i, o in enumerate(outs):
+                    env[(id(node), i)] = o
+                if training and node.op in ("BatchNorm", "BatchNorm_v1") \
+                        and not attrs.get("use_global_stats"):
+                    momentum = attrs.get("momentum", 0.9)
+                    for pos, stat_idx in ((3, 1), (4, 2)):
+                        p, _ = node.inputs[pos]
+                        if p.op is None:
+                            aux_up[p.name] = momentum * vmap[p.name] + \
+                                (1 - momentum) * outs[stat_idx]
+            return (tuple(env[k] for k in out_keys),
+                    tuple(aux_up[k] for k in aux_names))
+
+        return fn, aux_names
+
+    def eval_segmented(self, plan, arg_arrays, training=False,
+                       rng_key=None):
+        """Run a build_segment_plan: jitted segment programs with
+        device_put transfers between; returns (wanted values in plan
+        order, aux_updates)."""
+        import jax
+        env = {}
+        aux_updates = {}
+        if rng_key is None:
+            rng_key = jax.random.PRNGKey(0)
+        for seg in plan["segs"]:
+            entry = seg["jit"].get(training)
+            if entry is None:
+                raw, aux_names = self._make_segment_fn(seg, training)
+                entry = (jax.jit(raw), aux_names)
+                seg["jit"][training] = entry
+            jf, aux_names = entry
+            dev = seg["dev"]
+
+            def place(v):
+                return jax.device_put(v, dev) if dev is not None else v
+
+            invals = tuple(place(env[k]) for k in seg["in_keys"])
+            varvals = []
+            for nm in seg["var_names"]:
+                if nm not in arg_arrays:
+                    raise MXNetError(
+                        f"missing argument '{nm}' for eval")
+                varvals.append(place(arg_arrays[nm]))
+            outs, aux_vals = jf(invals, tuple(varvals), rng_key)
+            env.update(zip(seg["out_keys"], outs))
+            aux_updates.update(zip(aux_names, aux_vals))
+        out = []
+        for n, i in plan["want"]:
+            if n.op is None:
+                out.append(arg_arrays[n.name])
+            else:
+                out.append(env[(id(n), i)])
+        return out, aux_updates
+
     def eval_dict(self, arg_dict):
         """Evaluate with NDArray inputs → NDArray outputs (autograd-aware:
         the whole graph records as one tape node)."""

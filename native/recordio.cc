@@ -269,3 +269,269 @@ void rio_close(void* handle) {
 }
 
 }  // extern "C"
+
+// -- in-native JPEG decode + augment ----------------------------------------
+// The reference decodes on a C++ thread pool (iter_image_recordio_2.cc:727,
+// OpenCV backed by libjpeg-turbo). Here: libjpeg(-turbo) decode with DCT
+// scaling (decode directly at scale_num/8 resolution when the target is
+// smaller — the standard input-pipeline speedup), then bilinear
+// resize-shorter-side / crop / mirror matching image/mp_loader.py
+// _fast_augment, written straight into the caller's HWC uint8 buffer.
+
+#include <jpeglib.h>
+#include <setjmp.h>
+
+namespace {
+
+struct JErr {
+  jpeg_error_mgr pub;
+  jmp_buf jb;
+};
+
+void jerr_exit(j_common_ptr cinfo) {
+  JErr* e = reinterpret_cast<JErr*>(cinfo->err);
+  longjmp(e->jb, 1);
+}
+
+inline uint64_t xorshift64(uint64_t* s) {
+  uint64_t x = *s;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  return *s = x;
+}
+
+// bilinear HWC u8 resize (the cv2.INTER_LINEAR analog)
+void resize_bilinear(const uint8_t* src, int sh, int sw, uint8_t* dst,
+                     int dh, int dw) {
+  const float ry = static_cast<float>(sh) / dh;
+  const float rx = static_cast<float>(sw) / dw;
+  for (int y = 0; y < dh; ++y) {
+    float fy = (y + 0.5f) * ry - 0.5f;
+    int y0 = fy < 0 ? 0 : static_cast<int>(fy);
+    if (y0 > sh - 2) y0 = sh - 2;
+    if (y0 < 0) y0 = 0;               // 1-pixel-tall source
+    int y1 = y0 + 1 < sh ? y0 + 1 : y0;
+    float wy = fy - y0;
+    if (wy < 0) wy = 0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = (x + 0.5f) * rx - 0.5f;
+      int x0 = fx < 0 ? 0 : static_cast<int>(fx);
+      if (x0 > sw - 2) x0 = sw - 2;
+      if (x0 < 0) x0 = 0;             // 1-pixel-wide source
+      int x1 = x0 + 1 < sw ? x0 + 1 : x0;
+      float wx = fx - x0;
+      if (wx < 0) wx = 0;
+      const uint8_t* p00 = src + (y0 * sw + x0) * 3;
+      const uint8_t* p01 = src + (y0 * sw + x1) * 3;
+      const uint8_t* p10 = src + (y1 * sw + x0) * 3;
+      const uint8_t* p11 = src + (y1 * sw + x1) * 3;
+      for (int c = 0; c < 3; ++c) {
+        float v = (1 - wy) * ((1 - wx) * p00[c] + wx * p01[c]) +
+                  wy * ((1 - wx) * p10[c] + wx * p11[c]);
+        dst[(y * dw + x) * 3 + c] = static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}
+
+// IRHeader: uint32 flag, float label, uint64 id, id2 (reference
+// recordio.py IRFormat 'IfQQ'); flag>0 appends flag float labels.
+inline int64_t payload_offset(const uint8_t* p) {
+  uint32_t flag;
+  std::memcpy(&flag, p, 4);
+  return 24 + (flag > 0 ? static_cast<int64_t>(flag) * 4 : 0);
+}
+
+int decode_one(const uint8_t* jpg, uint64_t len, int out_h, int out_w,
+               int resize, int rand_crop, int rand_mirror, int fast_scale,
+               uint64_t seed, uint8_t* out, std::vector<uint8_t>* scratch,
+               std::vector<uint8_t>* scratch2) {
+  jpeg_decompress_struct cinfo;
+  JErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jerr_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(jpg),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  // DCT scaling: the smallest power-of-two num/8 (8,4,2,1 — libjpeg's
+  // fast iDCT paths; intermediate ratios fall into the slow generic
+  // scaler) such that both output dims stay >= what the pipeline needs
+  // (resize target or the crop window) — no upsampling is introduced
+  if (fast_scale) {
+    int need_h = resize > 0 ? resize : out_h;
+    int need_w = resize > 0 ? resize : out_w;
+    int num = 8;
+    for (int n : {1, 2, 4}) {
+      long sh = (static_cast<long>(cinfo.image_height) * n + 7) / 8;
+      long sw = (static_cast<long>(cinfo.image_width) * n + 7) / 8;
+      if (sh >= need_h && sw >= need_w) { num = n; break; }
+    }
+    cinfo.scale_num = num;
+    cinfo.scale_denom = 8;
+  }
+  jpeg_start_decompress(&cinfo);
+  int h = cinfo.output_height, w = cinfo.output_width;
+  if (cinfo.output_components != 3) {
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  scratch->resize(static_cast<size_t>(h) * w * 3);
+  {
+    uint8_t* rows = scratch->data();
+    while (cinfo.output_scanline < cinfo.output_height) {
+      JSAMPROW row = rows + static_cast<size_t>(
+          cinfo.output_scanline) * w * 3;
+      jpeg_read_scanlines(&cinfo, &row, 1);
+    }
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+
+  const uint8_t* img = scratch->data();
+  // resize-shorter-side (mp_loader._fast_augment semantics)
+  if (resize > 0) {
+    int nh, nw;
+    if (h < w) {
+      nh = resize;
+      nw = std::max<int64_t>(out_w, static_cast<int64_t>(w) * resize / h);
+    } else {
+      nw = resize;
+      nh = std::max<int64_t>(out_h, static_cast<int64_t>(h) * resize / w);
+    }
+    if (nh != h || nw != w) {
+      scratch2->resize(static_cast<size_t>(nh) * nw * 3);
+      resize_bilinear(img, h, w, scratch2->data(), nh, nw);
+      img = scratch2->data();
+      h = nh;
+      w = nw;
+    }
+  }
+  if (h < out_h || w < out_w) {
+    int nh = std::max(h, out_h), nw = std::max(w, out_w);
+    scratch2->resize(static_cast<size_t>(nh) * nw * 3);
+    resize_bilinear(img, h, w, scratch2->data(), nh, nw);
+    img = scratch2->data();
+    h = nh;
+    w = nw;
+  }
+  uint64_t rng = seed ? seed : 0x9E3779B97F4A7C15ull;
+  int y0, x0;
+  if (rand_crop) {
+    y0 = static_cast<int>(xorshift64(&rng) % (h - out_h + 1));
+    x0 = static_cast<int>(xorshift64(&rng) % (w - out_w + 1));
+  } else {
+    y0 = (h - out_h) / 2;
+    x0 = (w - out_w) / 2;
+  }
+  bool mirror = rand_mirror && (xorshift64(&rng) & 1);
+  for (int y = 0; y < out_h; ++y) {
+    const uint8_t* srow = img + ((y0 + y) * w + x0) * 3;
+    uint8_t* drow = out + static_cast<size_t>(y) * out_w * 3;
+    if (!mirror) {
+      std::memcpy(drow, srow, static_cast<size_t>(out_w) * 3);
+    } else {
+      for (int x = 0; x < out_w; ++x) {
+        const uint8_t* s = srow + (out_w - 1 - x) * 3;
+        drow[x * 3] = s[0];
+        drow[x * 3 + 1] = s[1];
+        drow[x * 3 + 2] = s[2];
+      }
+    }
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse the IRHeader label(s) of record idx into out[0..maxn); returns
+// the label count (reference recordio.py unpack: flag>0 means an array
+// of `flag` float labels follows the fixed header).
+int rio_record_label(void* handle, int64_t idx, float* out, int maxn) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (idx < 0 || idx >= static_cast<int64_t>(r->records.size())) return -1;
+  const Record& rec = r->records[idx];
+  thread_local std::vector<uint8_t> joined;
+  const uint8_t* p;
+  if (rec.segments.size() == 1) {
+    p = r->base + rec.segments[0].offset;
+  } else {
+    joined.resize(rec.total);
+    copy_record(r, rec, joined.data());
+    p = joined.data();
+  }
+  uint32_t flag;
+  std::memcpy(&flag, p, 4);
+  if (flag == 0) {
+    if (maxn >= 1) std::memcpy(out, p + 4, 4);
+    return 1;
+  }
+  int n = static_cast<int>(flag) < maxn ? static_cast<int>(flag) : maxn;
+  std::memcpy(out, p + 24, static_cast<size_t>(n) * 4);
+  return static_cast<int>(flag);
+}
+
+// Decode record idx's JPEG payload into out (HWC uint8, out_h*out_w*3).
+int rio_decode_record(void* handle, int64_t idx, int out_h, int out_w,
+                      int resize, int rand_crop, int rand_mirror,
+                      int fast_scale, uint64_t seed, uint8_t* out) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (idx < 0 || idx >= static_cast<int64_t>(r->records.size())) return -1;
+  const Record& rec = r->records[idx];
+  thread_local std::vector<uint8_t> scratch, scratch2, joined;
+  const uint8_t* payload;
+  uint64_t total = rec.total;
+  if (rec.segments.size() == 1) {
+    payload = r->base + rec.segments[0].offset;
+  } else {
+    joined.resize(total);
+    copy_record(r, rec, joined.data());
+    payload = joined.data();
+  }
+  int64_t skip = payload_offset(payload);
+  if (static_cast<uint64_t>(skip) >= total) return -3;
+  return decode_one(payload + skip, total - skip, out_h, out_w, resize,
+                    rand_crop, rand_mirror, fast_scale, seed, out,
+                    &scratch, &scratch2);
+}
+
+// Threaded batch decode: records idxs[0..n) -> out rows (n,out_h,out_w,3).
+// Returns 0, or the first nonzero per-record status.
+int rio_decode_batch(void* handle, const int64_t* idxs, int64_t n,
+                     int out_h, int out_w, int resize, int rand_crop,
+                     int rand_mirror, int fast_scale,
+                     const uint64_t* seeds, uint8_t* out, int nthreads) {
+  if (nthreads < 1) nthreads = 1;
+  std::atomic<int64_t> next(0);
+  std::atomic<int> status(0);
+  const size_t stride = static_cast<size_t>(out_h) * out_w * 3;
+  auto work = [&] {
+    int64_t i;
+    while ((i = next.fetch_add(1)) < n) {
+      int rc = rio_decode_record(handle, idxs[i], out_h, out_w, resize,
+                                 rand_crop, rand_mirror, fast_scale,
+                                 seeds ? seeds[i] : 0,
+                                 out + stride * i);
+      int expect = 0;
+      if (rc != 0) status.compare_exchange_strong(expect, rc);
+    }
+  };
+  if (nthreads == 1) {
+    work();
+  } else {
+    std::vector<std::thread> pool;
+    for (int t = 0; t < nthreads; ++t) pool.emplace_back(work);
+    for (auto& th : pool) th.join();
+  }
+  return status.load();
+}
+
+}  // extern "C"

@@ -20,6 +20,9 @@ import sys
 import tempfile
 import time
 
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
 
 def build_rec(tmp, n_images, w=480, h=360):
     import cv2
@@ -65,14 +68,59 @@ def run(it, n_batches, batch_size, label="", quiet=False):
     return img_s
 
 
+def decode_only(rec_path, n, out=224):
+    """Raw per-core decode rates (no pipeline): cv2 full decode vs the
+    in-native exact and DCT-1/2 fast paths (native/recordio.cc). This is
+    the number that scales with decode cores; the pipeline rows above it
+    are bounded by the single parent process on few-core hosts."""
+    import ctypes
+    import cv2
+    import numpy as np
+    from mxnet_tpu import recordio, native as native_mod
+    res = {}
+    idx_path = os.path.splitext(rec_path)[0] + ".idx"
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    raws = [recordio.unpack(rec.read_idx(k))[1] for k in list(rec.keys)[:n]]
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for raw in raws:
+            cv2.imdecode(np.frombuffer(raw, np.uint8), cv2.IMREAD_COLOR)
+        res["cv2_full"] = len(raws) / (time.perf_counter() - t0)
+    lib = native_mod.get_lib()
+    if lib is not None and hasattr(lib, "rio_decode_batch"):
+        h = lib.rio_open(rec_path.encode())
+        pos = np.arange(len(raws), dtype=np.int64)
+        seeds = np.arange(1, len(raws) + 1, dtype=np.uint64)
+        buf = np.empty((len(raws), out, out, 3), np.uint8)
+        for fast, tag in ((0, "native_exact"), (1, "native_fast")):
+            for _ in range(2):
+                t0 = time.perf_counter()
+                lib.rio_decode_batch(
+                    h, pos.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    len(raws), out, out, 0, 1, 1, fast,
+                    seeds.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                    buf.ctypes.data_as(ctypes.c_void_p), 1)
+                res[tag] = len(raws) / (time.perf_counter() - t0)
+        lib.rio_close(h)
+    return {k: round(v, 1) for k, v in res.items()}
+
+
 def main():
     n_images = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
     batch = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    # default fixture: 640x480 (the reference's standard resize=480
+    # shorter-side ImageNet packing, example/image-classification docs);
+    # pass w h to override
+    w = int(sys.argv[3]) if len(sys.argv) > 3 else 640
+    h = int(sys.argv[4]) if len(sys.argv) > 4 else 480
     import mxnet_tpu as mx
 
     with tempfile.TemporaryDirectory() as tmp:
-        rec = build_rec(tmp, n_images)
-        n_batches = max(4, n_images // batch - 2)
+        rec = build_rec(tmp, n_images, w=w, h=h)
+        # the mp ring pre-decodes nslots batches during warmup — measure
+        # well past the ring so rates reflect steady-state decode, not
+        # buffered slots
+        n_batches = max(24, n_images // batch - 2)
         kw = dict(path_imgrec=rec, data_shape=(3, 224, 224),
                   batch_size=batch, rand_crop=True, rand_mirror=True,
                   shuffle=True)
@@ -82,10 +130,30 @@ def main():
         run(it, n_batches, batch, "single")
 
         for n in (4, 8, 16):
+            os.environ["MXNET_TPU_NATIVE_DECODE"] = "0"
             it = mx.io.ImageRecordIter(preprocess_threads=n, dtype="uint8",
                                        as_numpy=True, **kw)
             run(it, n_batches, batch, f"mp{n}")
             it.close()
+            os.environ.pop("MXNET_TPU_NATIVE_DECODE", None)
+
+        # in-native decode (recordio.cc rio_decode_batch): exact path and
+        # the DCT-scaled fast path (decode at scale_num/8 — never
+        # upsamples; the standard input-pipeline speedup)
+        for n in (4, 8):
+            it = mx.io.ImageRecordIter(preprocess_threads=n, dtype="uint8",
+                                       as_numpy=True, **kw)
+            run(it, n_batches, batch, f"mp{n}-native")
+            it.close()
+            it = mx.io.ImageRecordIter(preprocess_threads=n, dtype="uint8",
+                                       as_numpy=True, fast_decode=True,
+                                       **kw)
+            run(it, n_batches, batch, f"mp{n}-native-fast")
+            it.close()
+
+        print(json.dumps({"decode_only_per_core":
+                          decode_only(rec, min(256, n_images))}),
+              flush=True)
 
 
 if __name__ == "__main__":
