@@ -8,11 +8,16 @@ converted checkpoints in place.
 """
 from __future__ import annotations
 
+import hashlib
 import os
 
-__all__ = ["get_model_file", "purge"]
+__all__ = ["get_model_file", "purge", "check_sha1"]
 
-_model_sha1 = {}  # name -> sha1, populated as checkpoints are converted
+# name -> sha1 of the .params artifact. The reference ships a static
+# table and verifies every download (model_store.py:30-60); here the
+# table covers vendored/converted artifacts and a ``{name}.sha1``
+# sidecar next to the file extends it per-root.
+_model_sha1 = {}
 
 
 def get_model_root():
@@ -20,18 +25,43 @@ def get_model_root():
         os.environ.get("MXNET_TPU_MODEL_ZOO", "~/.mxnet_tpu/models"))
 
 
+def check_sha1(filename, sha1_hash):
+    """True iff the file's sha1 matches (reference: utils.check_sha1)."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
 def get_model_file(name, root=None):
-    """Return the path of a pretrained model parameters file
-    (reference: model_store.py:68)."""
+    """Return the path of a pretrained model parameters file, sha1-
+    verified when a checksum is known (reference: model_store.py:68 —
+    the download step is replaced by a local root since this environment
+    has no egress)."""
     root = root or get_model_root()
     file_path = os.path.join(root, f"{name}.params")
-    if os.path.exists(file_path):
-        return file_path
-    raise FileNotFoundError(
-        f"Pretrained model file {file_path} is not found. This environment "
-        "has no network egress; place a converted checkpoint at that path "
-        "(see tools/convert_params.py) or construct the model with "
-        "pretrained=False.")
+    if not os.path.exists(file_path):
+        raise FileNotFoundError(
+            f"Pretrained model file {file_path} is not found. This "
+            "environment has no network egress; place a converted "
+            "checkpoint at that path (see tools/convert_params.py) or "
+            "construct the model with pretrained=False.")
+    sha1_hash = _model_sha1.get(name)
+    sidecar = file_path + ".sha1"
+    if sha1_hash is None and os.path.exists(sidecar):
+        with open(sidecar) as f:
+            parts = f.read().split()
+        sha1_hash = parts[0] if parts else None
+    if sha1_hash and not check_sha1(file_path, sha1_hash):
+        raise ValueError(
+            f"sha1 mismatch for {file_path}: the artifact is corrupted "
+            "or was replaced (reference model_store re-downloads here; "
+            "restore the checkpoint or remove the stale file)")
+    return file_path
 
 
 def purge(root=None):

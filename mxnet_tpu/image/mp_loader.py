@@ -78,10 +78,14 @@ def _fast_augment(img, out_hw, rand_crop, rand_mirror, resize, rng,
     return img
 
 
-def _native_decoder(path_imgrec, idx_keys, interp, c):
-    """(lib, handle, key->position map) for the in-native decode path
-    (native/recordio.cc rio_decode_batch), or None when unavailable /
-    not applicable (non-RGB, non-linear interp)."""
+def _native_decoder(path_imgrec, idx_keys, shard_keys, interp, c):
+    """(lib, handle, key->position map FOR THIS SHARD) for the in-native
+    decode path (native/recordio.cc rio_decode_batch), or None when
+    unavailable / not applicable (non-RGB, non-linear interp). The
+    offset->position mapping is one bulk C call + a vectorized
+    searchsorted over the shard's keys only — no per-record ctypes round
+    trips and no whole-dataset dict per worker."""
+    import ctypes
     import cv2
     if c != 3 or interp != cv2.INTER_LINEAR:
         return None
@@ -90,20 +94,27 @@ def _native_decoder(path_imgrec, idx_keys, interp, c):
     try:
         from .. import native as native_mod
         lib = native_mod.get_lib()
-        if lib is None or not hasattr(lib, "rio_decode_batch"):
+        if lib is None or not hasattr(lib, "rio_decode_batch") or \
+                not hasattr(lib, "rio_record_offsets"):
             return None
         h = lib.rio_open(path_imgrec.encode())
         if not h:
             return None
-        n = lib.rio_count(h)
-        off2pos = {int(lib.rio_record_offset(h, p)): p for p in range(n)}
-        key2pos = {}
-        for k, off in idx_keys.items():
-            p = off2pos.get(int(off))
-            if p is None:
-                lib.rio_close(h)
-                return None
-            key2pos[int(k)] = p
+        n = int(lib.rio_count(h))
+        offsets = np.empty(n, np.int64)
+        lib.rio_record_offsets(
+            h, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        order = np.argsort(offsets, kind="stable")
+        sorted_off = offsets[order]
+        want_off = np.array([int(idx_keys[int(k)]) for k in shard_keys],
+                            np.int64)
+        slots = np.searchsorted(sorted_off, want_off)
+        if (slots >= n).any() or (sorted_off[np.minimum(slots, n - 1)]
+                                  != want_off).any():
+            lib.rio_close(h)
+            return None
+        pos = order[slots]
+        key2pos = {int(k): int(p) for k, p in zip(shard_keys, pos)}
         return lib, h, key2pos
     except Exception:
         return None
@@ -121,7 +132,7 @@ def _worker(rank, path_imgrec, path_imgidx, keys, batch_size, data_shape,
     cv2.setNumThreads(0)  # one process = one core; don't oversubscribe
     c, oh, ow = data_shape
     rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
-    native = _native_decoder(path_imgrec, rec.idx, interp, c)
+    native = _native_decoder(path_imgrec, rec.idx, keys, interp, c)
     shm = shared_memory.SharedMemory(name=shm_name)
     lbl_shm = shared_memory.SharedMemory(name=lbl_shm_name)
     slot_shape = (nslots, batch_size, c, oh, ow)

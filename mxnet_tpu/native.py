@@ -38,8 +38,8 @@ def get_lib():
         if _lib is not None or _tried:
             return _lib
         _tried = True
+        src = os.path.join(_NATIVE_DIR, "recordio.cc")
         try:
-            src = os.path.join(_NATIVE_DIR, "recordio.cc")
             # rebuild BEFORE the first dlopen when the source is newer —
             # relinking an already-mapped .so truncates live code pages,
             # and a second CDLL on the same inode returns the stale
@@ -48,6 +48,12 @@ def get_lib():
                     os.path.exists(src) and
                     os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)):
                 _build()
+        except Exception:
+            # rebuild failed (e.g. no libjpeg on this host): a prebuilt
+            # library still serves the reader/prefetch surface — decode
+            # consumers probe hasattr(rio_decode_batch) and degrade
+            pass
+        try:
             lib = ctypes.CDLL(_LIB_PATH)
         except Exception:
             return None
@@ -93,6 +99,10 @@ def get_lib():
             lib.rio_record_label.argtypes = [
                 ctypes.c_void_p, ctypes.c_int64,
                 ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+        if hasattr(lib, "rio_record_offsets"):
+            lib.rio_record_offsets.restype = ctypes.c_int64
+            lib.rio_record_offsets.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
         _lib = lib
         return _lib
 

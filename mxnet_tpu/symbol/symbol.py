@@ -302,6 +302,57 @@ class Symbol:
                 dmap[node.name] = default_device
         return dmap
 
+    @staticmethod
+    def _apply_node_op(node, ins, training, rng_key):
+        """Dispatch ONE op node on resolved input values — the single
+        place that parses attrs and injects training flags / per-node
+        RNG keys. Shared by the eager walk (eval_arrays_ex) and the
+        segmented walk (_make_segment_fn): the two must stay
+        bit-identical (same uid fold salt, same BN semantics) or the
+        Monitor's tapped pass diverges from training. Returns
+        (outs tuple, parsed attrs)."""
+        import jax
+        from ..ops.registry import get_op
+        attrs = {k: parse_attr(v) for k, v in node.attrs.items()
+                 if not k.startswith("__")}
+        opdef = get_op(node.op)
+        if node.op in ("BatchNorm", "BatchNorm_v1", "Dropout", "RNN"):
+            attrs["training"] = training
+        if node.op in ("Dropout", "RNN") and training:
+            base = rng_key if rng_key is not None \
+                else jax.random.PRNGKey(0)
+            # salt by the node's uid (not topo index): sub-graph evals
+            # (implicit-loss recompute) then draw the SAME key per node,
+            # so forward and backward see identical dropout masks
+            attrs["key"] = jax.random.fold_in(base, node.uid % (2 ** 31))
+        innames = node.attrs.get("__input_names__")
+        if innames:
+            res = opdef.fn(**dict(zip(parse_attr(innames), ins)),
+                           **attrs)
+        else:
+            res = opdef.fn(*ins, **attrs)
+        return (res if isinstance(res, tuple) else (res,)), attrs
+
+    @staticmethod
+    def _bn_aux_updates(node, outs, attrs, training, resolve_var):
+        """[(aux var name, new value)] BatchNorm running-stat folds
+        (functional form of the reference's in-place aux mutation,
+        batch_norm.cc). ``resolve_var(p)`` -> the variable's current
+        value. Shared by both graph walkers."""
+        if not training or node.op not in ("BatchNorm", "BatchNorm_v1") \
+                or attrs.get("use_global_stats"):
+            return []
+        momentum = attrs.get("momentum", 0.9)
+        ups = []
+        for pos, stat_idx in ((3, 1), (4, 2)):
+            p, _ = node.inputs[pos]
+            if p.op is None:
+                old = resolve_var(p)
+                ups.append((p.name,
+                            momentum * old +
+                            (1 - momentum) * outs[stat_idx]))
+        return ups
+
     def eval_arrays_ex(self, arg_arrays: Dict[str, "np.ndarray"],
                       training=False, rng_key=None, internals=None,
                       device_map=None):
@@ -344,41 +395,17 @@ class Symbol:
                 dev = device_map.get(node.name)
                 if dev is not None:
                     ins = [jax.device_put(v, dev) for v in ins]
-            attrs = {k: parse_attr(v) for k, v in node.attrs.items()
-                     if not k.startswith("__")}
-            opdef = get_op(node.op)
-            if node.op in ("BatchNorm", "BatchNorm_v1", "Dropout", "RNN"):
-                attrs["training"] = training
-            if node.op in ("Dropout", "RNN") and training:
-                base = rng_key if rng_key is not None \
-                    else jax.random.PRNGKey(0)
-                # salt by the node's uid (not topo index): sub-graph evals
-                # (implicit-loss recompute) then draw the SAME key per node,
-                # so forward and backward see identical dropout masks
-                attrs["key"] = jax.random.fold_in(base,
-                                                  node.uid % (2 ** 31))
-            innames = node.attrs.get("__input_names__")
-            if innames:
-                res = opdef.fn(**dict(zip(parse_attr(innames), ins)),
-                               **attrs)
-            else:
-                res = opdef.fn(*ins, **attrs)
-            outs = res if isinstance(res, tuple) else (res,)
+            outs, attrs = Symbol._apply_node_op(node, ins, training,
+                                                rng_key)
             for i, o in enumerate(outs):
                 cache[(id(node), i)] = o
                 if internals is not None:
                     suffix = "_output" if i == 0 else f"_output{i}"
                     internals[node.name + suffix] = o
-            if training and node.op in ("BatchNorm", "BatchNorm_v1") and \
-                    not attrs.get("use_global_stats"):
-                momentum = attrs.get("momentum", 0.9)
-                # inputs 3,4 are the aux moving_mean/moving_var variables
-                for pos, stat_idx in ((3, 1), (4, 2)):
-                    p, _ = node.inputs[pos]
-                    if p.op is None:
-                        old = node_out(p, 0)
-                        aux_updates[p.name] = momentum * old + \
-                            (1 - momentum) * outs[stat_idx]
+            for name, val in Symbol._bn_aux_updates(
+                    node, outs, attrs, training,
+                    lambda p: node_out(p, 0)):
+                aux_updates[name] = val
             return cache[key]
 
         outputs = [node_out(s._node, s._out_index)
@@ -452,9 +479,6 @@ class Symbol:
     def _make_segment_fn(self, seg, training):
         """(fn, aux_names): pure fn(invals, varvals, key) ->
         (outvals, aux_update_vals ordered by aux_names)."""
-        import jax
-        from ..ops.registry import get_op
-
         nodes = seg["nodes"]
         in_keys = list(seg["in_keys"])
         out_keys = list(seg["out_keys"])
@@ -480,36 +504,16 @@ class Symbol:
             vmap = dict(zip(var_names, varvals))
             aux_up = {}
             for node in nodes:
-                ins = []
-                for p, i in node.inputs:
-                    ins.append(vmap[p.name] if p.op is None
-                               else env[(id(p), i)])
-                attrs = {k: parse_attr(v) for k, v in node.attrs.items()
-                         if not k.startswith("__")}
-                opdef = get_op(node.op)
-                if node.op in ("BatchNorm", "BatchNorm_v1", "Dropout",
-                               "RNN"):
-                    attrs["training"] = training
-                if node.op in ("Dropout", "RNN") and training:
-                    attrs["key"] = jax.random.fold_in(
-                        key, node.uid % (2 ** 31))
-                innames = node.attrs.get("__input_names__")
-                if innames:
-                    res = opdef.fn(**dict(zip(parse_attr(innames), ins)),
-                                   **attrs)
-                else:
-                    res = opdef.fn(*ins, **attrs)
-                outs = res if isinstance(res, tuple) else (res,)
+                ins = [vmap[p.name] if p.op is None else env[(id(p), i)]
+                       for p, i in node.inputs]
+                outs, attrs = Symbol._apply_node_op(node, ins, training,
+                                                    key)
                 for i, o in enumerate(outs):
                     env[(id(node), i)] = o
-                if training and node.op in ("BatchNorm", "BatchNorm_v1") \
-                        and not attrs.get("use_global_stats"):
-                    momentum = attrs.get("momentum", 0.9)
-                    for pos, stat_idx in ((3, 1), (4, 2)):
-                        p, _ = node.inputs[pos]
-                        if p.op is None:
-                            aux_up[p.name] = momentum * vmap[p.name] + \
-                                (1 - momentum) * outs[stat_idx]
+                for name, val in Symbol._bn_aux_updates(
+                        node, outs, attrs, training,
+                        lambda p: vmap[p.name]):
+                    aux_up[name] = val
             return (tuple(env[k] for k in out_keys),
                     tuple(aux_up[k] for k in aux_names))
 

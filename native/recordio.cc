@@ -174,6 +174,16 @@ int64_t rio_record_offset(void* handle, int64_t idx) {
   return static_cast<int64_t>(r->records[idx].segments[0].offset) - 8;
 }
 
+// all record header offsets in one call (out must hold rio_count slots) —
+// lets .idx-key -> position mapping be one vectorized searchsorted on the
+// Python side instead of per-record ctypes round trips
+int64_t rio_record_offsets(void* handle, int64_t* out) {
+  Reader* r = static_cast<Reader*>(handle);
+  for (size_t i = 0; i < r->records.size(); ++i)
+    out[i] = static_cast<int64_t>(r->records[i].segments[0].offset) - 8;
+  return static_cast<int64_t>(r->records.size());
+}
+
 int rio_record_copy(void* handle, int64_t idx, void* dst) {
   Reader* r = static_cast<Reader*>(handle);
   if (idx < 0 || idx >= static_cast<int64_t>(r->records.size())) return -1;

@@ -20,11 +20,6 @@ sys.path.insert(0, os.path.join(os.path.dirname(
     os.path.abspath(__file__)), ".."))
 
 import jax                                    # noqa: E402
-
-# this CPU backend's default-precision matmuls carry ~5e-3 relative
-# error, which finite differences amplify ~1/eps-fold — force exact f32
-jax.config.update("jax_default_matmul_precision", "highest")
-
 import jax.numpy as jnp                       # noqa: E402
 
 from mxnet_tpu.ops.registry import _OPS       # noqa: E402
@@ -68,7 +63,16 @@ def default_case(opdef):
 
 
 def run_case(opdef, case, eps=1e-2, rtol=5e-2, atol=5e-3):
-    """Returns (status, detail). status: ok / fwd_ok / fail / error."""
+    """Returns (status, detail). status: ok / fwd_ok / fail / error.
+
+    Runs under matmul precision 'highest' (scoped, not a global config
+    write): this CPU backend's default-precision matmuls carry ~5e-3
+    relative error, which central differences amplify ~1/eps-fold."""
+    with jax.default_matmul_precision("highest"):
+        return _run_case_inner(opdef, case, eps, rtol, atol)
+
+
+def _run_case_inner(opdef, case, eps, rtol, atol):
     inputs = [jnp.asarray(v) for v in case["inputs"]]
     attrs = case.get("attrs", {})
     mode = case.get("mode", "grad")
@@ -156,6 +160,12 @@ def sweep(cases, only=None):
         if verbose and time.perf_counter() - t0 > 2:
             print(f"    slow: {time.perf_counter() - t0:.1f}s",
                   flush=True)
+    dump = os.environ.get("GRAD_SWEEP_DUMP")
+    if dump:
+        import json
+        with open(dump, "w") as f:
+            json.dump({n: list(v) for n, v in results.items()
+                       if v[0] in ("fail", "error")}, f, indent=1)
     return results
 
 
