@@ -8,8 +8,9 @@ converted checkpoints in place.
 """
 from __future__ import annotations
 
-import hashlib
 import os
+
+from ..utils import check_sha1
 
 __all__ = ["get_model_file", "purge", "check_sha1"]
 
@@ -23,18 +24,6 @@ _model_sha1 = {}
 def get_model_root():
     return os.path.expanduser(
         os.environ.get("MXNET_TPU_MODEL_ZOO", "~/.mxnet_tpu/models"))
-
-
-def check_sha1(filename, sha1_hash):
-    """True iff the file's sha1 matches (reference: utils.check_sha1)."""
-    sha1 = hashlib.sha1()
-    with open(filename, "rb") as f:
-        while True:
-            data = f.read(1048576)
-            if not data:
-                break
-            sha1.update(data)
-    return sha1.hexdigest() == sha1_hash
 
 
 def get_model_file(name, root=None):

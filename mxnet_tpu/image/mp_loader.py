@@ -94,16 +94,22 @@ def _native_decoder(path_imgrec, idx_keys, shard_keys, interp, c):
     try:
         from .. import native as native_mod
         lib = native_mod.get_lib()
-        if lib is None or not hasattr(lib, "rio_decode_batch") or \
-                not hasattr(lib, "rio_record_offsets"):
+        if lib is None or not hasattr(lib, "rio_decode_batch"):
             return None
         h = lib.rio_open(path_imgrec.encode())
         if not h:
             return None
         n = int(lib.rio_count(h))
         offsets = np.empty(n, np.int64)
-        lib.rio_record_offsets(
-            h, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if hasattr(lib, "rio_record_offsets"):
+            lib.rio_record_offsets(
+                h, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        else:
+            # prebuilt library that predates the bulk call (rebuild
+            # toolchain unavailable): per-record round trips still beat
+            # losing native decode entirely
+            for p in range(n):
+                offsets[p] = lib.rio_record_offset(h, p)
         order = np.argsort(offsets, kind="stable")
         sorted_off = offsets[order]
         want_off = np.array([int(idx_keys[int(k)]) for k in shard_keys],

@@ -3,6 +3,7 @@ tests/python/unittest/test_operator.py test_custom_op,
 python/mxnet/operator.py:422-627; rtc capability: python/mxnet/rtc.py).
 """
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd
@@ -104,6 +105,19 @@ class TestCustomOp:
 
 
 class TestPallasHook:
+    @pytest.fixture(autouse=True)
+    def _unregister(self):
+        """These tests register ops into the PROCESS-GLOBAL registry;
+        leaving them there pollutes registry-walking tests (the op
+        gradient sweep picks them up with incompatible fixtures)."""
+        yield
+        from mxnet_tpu.ops.registry import _OPS
+        import mxnet_tpu.ndarray as nd_mod
+        for name in ("pallas_double", "pallas_scale3"):
+            _OPS.pop(name, None)
+            if hasattr(nd_mod, name):
+                delattr(nd_mod, name)
+
     def test_register_pallas_op(self):
         def double_kernel(x_ref, o_ref):
             o_ref[...] = x_ref[...] * 2.0

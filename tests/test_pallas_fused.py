@@ -1,0 +1,46 @@
+"""Correctness of the fused BN-apply+ReLU+matmul Pallas kernel
+(tools/pallas_fused_bn_bench.py — the identified path past the v5e HBM
+roofline, docs/perf_analysis.md §3). Runs the real kernel on TPU and
+interpret mode elsewhere."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", "tools"))
+
+
+def test_bn_relu_matmul_matches_unfused():
+    import jax
+    import jax.numpy as jnp
+    import functools
+    from jax.experimental import pallas as pl
+    from pallas_fused_bn_bench import _kernel, unfused
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    m, k, n = 512, 64, 256
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(m, k).astype(np.float32))
+    w = jnp.asarray(rng.randn(k, n).astype(np.float32) * 0.1)
+    scale = jnp.asarray(rng.rand(k).astype(np.float32) + 0.5)
+    shift = jnp.asarray(rng.randn(k).astype(np.float32) * 0.1)
+
+    bm, bn = 256, 128
+    out = pl.pallas_call(
+        _kernel,
+        grid=(m // bm, n // bn),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((k, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, k), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, k), lambda i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        interpret=not on_tpu,
+    )(x, w, scale.reshape(1, k), shift.reshape(1, k))
+    ref = unfused(x, w, scale, shift)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

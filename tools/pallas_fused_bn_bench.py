@@ -1,0 +1,151 @@
+"""Pallas fused BN-apply+ReLU+matmul kernel (+ best-effort microbench).
+
+docs/perf_analysis.md shows single-chip ResNet-50 training is
+HBM-bandwidth-bound: every BN'd activation is touched ~8x per step, and
+XLA cannot fuse the normalize/activation pass into the MXU convolution
+that consumes it. The cuDNN-style fix is a kernel whose PROLOGUE applies
+BN+ReLU while tiles stream into the matmul — eliminating the
+materialized normalized tensor (one write + one read of the full
+activation) per 1x1 convolution. ``bn_relu_matmul`` below is that kernel
+for the 1x1-conv-as-matmul case; correctness is pinned by
+tests/test_pallas_fused.py (interpret mode off-TPU, real kernel on TPU).
+
+MEASUREMENT CAVEAT: standalone kernel timings through this environment's
+tunneled runtime are unreliable — block_until_ready must be "armed" by a
+host fetch, lax.scan bodies lower with conservative scheduling, and
+XLA's algebraic simplifier collapses linear-op repetition chains. The
+authoritative performance numbers are whole-step (bench.py + the xplane
+profile in tools/step_profile.py); whole-step integration of this kernel
+(rewriting the symbolic executor's conv+BN pattern) is the identified
+next step and was deliberately not rushed into the flagship path.
+
+Usage: python tools/pallas_fused_bn_bench.py [M] [K] [N]
+"""
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+import jax                                     # noqa: E402
+import jax.numpy as jnp                        # noqa: E402
+from jax.experimental import pallas as pl      # noqa: E402
+
+
+def _kernel(x_ref, w_ref, scale_ref, shift_ref, o_ref):
+    """One (bm, bn) output tile: normalize+ReLU the x tile on the fly
+    (VMEM, fused into the MXU feed) and contract over the whole K."""
+    x = x_ref[...]
+    xhat = jnp.maximum(
+        x * scale_ref[...] + shift_ref[...], 0.0).astype(x.dtype)
+    o_ref[...] = jnp.dot(
+        xhat, w_ref[...],
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn"))
+def bn_relu_matmul(x, w, scale, shift, bm=1024, bn=256):
+    """relu(x * scale + shift) @ w without materializing the normalized
+    activation. x: (M, K); w: (K, N); scale/shift: (K,) — the folded
+    BN parameters gamma/sqrt(var+eps) and beta - mu*scale."""
+    m, k = x.shape
+    _, n = w.shape
+    if m % bm or n % bn:
+        raise ValueError(
+            f"bn_relu_matmul needs M % bm == 0 and N % bn == 0 "
+            f"(got M={m}, N={n}, bm={bm}, bn={bn}); pad the problem or "
+            "pass smaller blocks — a truncated grid would leave output "
+            "tiles uninitialized")
+    grid = (m // bm, n // bn)
+    return pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((k, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, k), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, k), lambda i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+    )(x, w, scale.reshape(1, k), shift.reshape(1, k))
+
+
+@jax.jit
+def unfused(x, w, scale, shift):
+    xhat = jnp.maximum(x * scale + shift, 0.0).astype(x.dtype)
+    return jnp.dot(xhat, w, preferred_element_type=jnp.float32).astype(
+        x.dtype)
+
+
+def _time(f, x, w, scale, shift, inner=16, reps=5):
+    """Per-application time with the op repeated INSIDE one jitted scan
+    (a lone kernel launch through this environment's tunneled runtime
+    pays a ~4 ms dispatch floor that would swamp a sub-ms op). The input
+    is perturbed per iteration so XLA cannot hoist the op out of the
+    loop; the perturbation (one extra elementwise pass) is identical for
+    both candidates."""
+
+    @jax.jit
+    def many(x, w, scale, shift):
+        # straight-line unrolled chain (lax.scan bodies lower with
+        # conservative scheduling on TPU and distort kernel time); the
+        # carried scalar feeds the next input, so XLA can neither hoist
+        # the op nor collapse iterations (relu breaks linearity)
+        acc = jnp.float32(0)
+        for _ in range(inner):
+            xi = x + acc.astype(x.dtype)
+            z = f(xi, w, scale, shift)
+            acc = jnp.sum(z.astype(jnp.float32)) * jnp.float32(1e-12)
+        return acc
+
+    out = many(x, w, scale, shift)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = many(x, w, scale, shift)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best / inner
+
+
+def main():
+    m = int(sys.argv[1]) if len(sys.argv) > 1 else 128 * 56 * 56
+    k = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    n = int(sys.argv[3]) if len(sys.argv) > 3 else 256
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(m, k).astype(np.float32),
+                    jnp.bfloat16)
+    w = jnp.asarray(rng.randn(k, n).astype(np.float32) * 0.1,
+                    jnp.bfloat16)
+    scale = jnp.asarray(rng.rand(k).astype(np.float32) + 0.5,
+                        jnp.bfloat16)
+    shift = jnp.asarray(rng.randn(k).astype(np.float32) * 0.1,
+                        jnp.bfloat16)
+    # correctness
+    a = np.asarray(bn_relu_matmul(x, w, scale, shift), np.float32)
+    b = np.asarray(unfused(x, w, scale, shift), np.float32)
+    err = np.abs(a - b).max() / max(np.abs(b).max(), 1e-6)
+    t_f = _time(lambda a, b, c, d: bn_relu_matmul(a, b, c, d),
+                x, w, scale, shift)
+    t_u = _time(unfused, x, w, scale, shift)
+    bytes_min = (m * k + k * n + m * n) * 2          # one touch each
+    bytes_unfused = (2 * m * k + k * n + m * n) * 2  # + write/read xhat
+    print(f"M={m} K={k} N={n} bf16   rel err {err:.3e}")
+    print(f"unfused (XLA)  : {t_u*1e3:7.3f} ms  "
+          f"{bytes_unfused/t_u/1e9:6.0f} GB/s effective")
+    print(f"fused (pallas) : {t_f*1e3:7.3f} ms  "
+          f"{bytes_min/t_f/1e9:6.0f} GB/s effective")
+    print(f"speedup        : {t_u/t_f:0.2f}x   "
+          f"(traffic floor ratio {bytes_unfused/bytes_min:0.2f}x)")
+
+
+if __name__ == "__main__":
+    main()
