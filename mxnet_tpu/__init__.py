@@ -31,6 +31,7 @@ from .attribute import AttrScope
 from . import symbol
 from . import symbol as sym
 from .symbol import Symbol
+from .symbol.fusion import fusion_report
 from . import executor
 from .executor import Executor
 from . import initializer

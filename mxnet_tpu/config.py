@@ -12,7 +12,7 @@ from __future__ import annotations
 import os
 from typing import Any, Callable, Dict
 
-__all__ = ["get", "register", "show", "variables"]
+__all__ = ["get", "override", "register", "show", "variables"]
 
 
 from .base import get_env as _get_env
@@ -35,6 +35,30 @@ def get(name: str, default=None):
         eff_default = default if default is not None else reg_default
         return _get_env(name, eff_default, dtype=typ)
     return _get_env(name, default)
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def override(name: str, value):
+    """Temporarily force a configuration variable's environment value
+    (None removes it). The one save/set/restore used by the bench and
+    sweep A/B toggles and the fusion tests — config state lives in the
+    environment, so this is also the single place to change if that
+    ever moves."""
+    old = os.environ.get(name)
+    if value is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = str(value)
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = old
 
 
 def variables():
@@ -80,6 +104,10 @@ register("MXNET_USE_NATIVE_IO", True, bool,
 register("MXNET_BACKWARD_DO_MIRROR", False, bool,
          "Recompute activations in backward (jax.checkpoint) to trade "
          "FLOPs for memory (env_var.md:93)")
+register("MXTPU_PALLAS_FUSION", "auto", str,
+         "Graph-rewrite pass routing BN(+ReLU)->1x1-conv subgraphs "
+         "through the Pallas fused kernel (symbol/fusion.py): 1/0 force "
+         "on/off, auto = on for TPU backends, off elsewhere")
 
 
 def _autostart_profiler():

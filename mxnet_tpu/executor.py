@@ -199,6 +199,7 @@ class Executor:
         self._batch_args = set()   # arg names sharded over the batch axis
         self._group2ctx = dict(group2ctx) if group2ctx else None
         self._device_map = None    # node -> device (group2ctx builds)
+        self._fusion_report = None  # set by _build when the pass runs
 
     @property
     def arg_arrays(self):
@@ -271,7 +272,24 @@ class Executor:
                                            has_aux=True)
             return
 
-        fwd, fwd_loss, loss_specs = build_graph_fns(self._symbol)
+        # Pallas BN(+ReLU)→1×1-conv fusion (symbol/fusion.py, flag
+        # MXTPU_PALLAS_FUSION): the jitted functions are built from a
+        # rewritten graph; self._symbol stays the source of truth for
+        # names, serialization and the Monitor's tapped eager pass.
+        # Bound array shapes decide tile-divisibility bail-outs here.
+        # Multi-context (mesh) binds skip the pass: GSPMD cannot
+        # partition through the opaque Pallas custom call.
+        sym = self._symbol
+        if self._mesh is None:
+            from .symbol.fusion import maybe_fuse
+            shapes = {n: tuple(a.shape) for n, a in
+                      list(self.arg_dict.items()) +
+                      list(self.aux_dict.items())}
+            fused_sym, self._fusion_report = maybe_fuse(
+                self._symbol, shapes, tag="executor")
+            if fused_sym is not None:
+                sym = fused_sym
+        fwd, fwd_loss, loss_specs = build_graph_fns(sym)
         self._loss_specs = loss_specs
         self._fwd_jit = jax.jit(fwd, static_argnums=(3,))
         self._fwd_loss_grad = jax.jit(jax.grad(fwd_loss, argnums=0,

@@ -322,18 +322,39 @@ def inline_update(fused, metric, label_dict, pred_dict) -> bool:
     if all(r is not None and r.valid(fused) and
            r.shape_sig == p[5] for r, p in zip(refs, plans)):
         # counters advance inside the step — but only contiguous
-        # per-step calls keep the window attributable. A gap (steps ran
-        # without update_metric) means the counter holds batches never
-        # submitted: discard the window and drop to the sync path.
+        # per-step calls keep the window attributable.
         if all(fused.num_update == r.seen_t + 1 for r in refs):
             for r in refs:
                 r.seen_t = fused.num_update
             return True
-        for r, p in zip(refs, plans):
-            r.discard()
-            fused.release_metric_slot(r.idx)
-            p[0]._dev_acc = None
-        return False
+        # mixed per-call states: settle EACH leaf under its own
+        # contract (a composite can mix them when one leaf was also
+        # updated standalone this batch) — a blanket discard here
+        # silently dropped contiguous siblings' submitted batches.
+        for r, (m, ld, pd) in zip(refs, leaves):
+            if fused.num_update == r.seen_t + 1:
+                # contiguous first call for this batch: the in-step
+                # counter holds it — stay attached
+                r.seen_t = fused.num_update
+            elif fused.num_update == r.seen_t:
+                # double call for the SAME batch — no gap: fold the
+                # window (discarding silently lost it), release the
+                # slot, and count this batch a second time — the
+                # reference's per-call double-count semantics
+                r.flush(m)
+                fused.release_metric_slot(r.idx)
+                m._dev_acc = None
+                m.update_dict(ld, pd)
+            else:
+                # true gap: the counter holds steps whose batches were
+                # never submitted via update_metric — the window is not
+                # attributable, so it is dropped (lossy by design) and
+                # only the current batch counts, synchronously
+                r.discard()
+                fused.release_metric_slot(r.idx)
+                m._dev_acc = None
+                m.update_dict(ld, pd)
+        return True
     if any(r is not None and r.valid(fused) and r.shape_sig != p[5]
            for r, p in zip(refs, plans)):
         # batch shapes changed since attach: fold what's counted (exact
@@ -341,10 +362,27 @@ def inline_update(fused, metric, label_dict, pred_dict) -> bool:
         # with the new shape templates
         flush_and_detach(fused)
     # a partially-attached plan (e.g. a leaf later joins a composite):
-    # fold the still-valid refs' windows before they're re-slotted
+    # settle the still-valid refs' windows before they're re-slotted,
+    # under the SAME per-call contract as the all-valid branch above —
+    # a contiguous window folds (and, being counted in-step, covers
+    # this batch, so its leaf must skip the final sync update that
+    # previously double-counted every partial re-attach); a double
+    # call folds but still earns the second sync count; a true gap is
+    # unattributable and is discarded.
+    covered = set()
     for r, p in zip(refs, plans):
         if r is not None and r.valid(fused):
-            r.flush(p[0])
+            if fused.num_update == r.seen_t + 1:
+                # contiguous first call for this batch: the in-step
+                # counter already holds it
+                r.flush(p[0])
+                covered.add(id(p[0]))
+            elif fused.num_update == r.seen_t:
+                # double call, no gap: fold, then the sync pass below
+                # counts this batch a second time (per-call semantics)
+                r.flush(p[0])
+            else:
+                r.discard()
             p[0]._dev_acc = None
     # build EVERY rule first (a late shape failure must not leave a
     # partially-attached plan — sync + in-step would double count),
@@ -363,6 +401,10 @@ def inline_update(fused, metric, label_dict, pred_dict) -> bool:
     for m, sig, init, lnames, pnames, fn, inst, shape_sig in built_rules:
         idx = fused.attach_metric(m, sig, init, lnames, pnames, fn)
         m._dev_acc = _DevRef(fused, idx, inst, shape_sig)
-    # the already-run step for THIS batch isn't in the counters
-    metric.update_dict(label_dict, pred_dict)
+    # the already-run step for THIS batch isn't in the freshly-attached
+    # counters — count it synchronously PER LEAF, skipping leaves whose
+    # just-flushed window already covered it
+    for (m, ld, pd), _plan in zip(leaves, plans):
+        if id(m) not in covered:
+            m.update_dict(ld, pd)
     return True

@@ -75,6 +75,7 @@ class FusedSymbolStep:
         self._wd_eff = [optimizer.wd * optimizer.wd_mult.get(n, 1.0)
                         for n in self.param_names]
         _, self._fwd_loss, _ = build_graph_fns(symbol)
+        self.fusion_report = None   # set by start() when the pass runs
         from .. import random as _random
         self._base_key = _random.next_key()
         # big params / per-param opt state (aligned with _big_names)
@@ -162,6 +163,21 @@ class FusedSymbolStep:
     def start(self, arg_dict, aux_dict):
         """Capture initial parameter/aux values (copies — our buffers get
         donated, the executor's must stay live for eval paths)."""
+        # Pallas BN(+ReLU)→1×1-conv fusion (symbol/fusion.py, flag
+        # MXTPU_PALLAS_FUSION): the whole-step program traces the
+        # rewritten graph; self.symbol stays authoritative for names.
+        # Deferred to start() because the tile-divisibility bail-outs
+        # need the bound array shapes. Mesh (multi-chip) steps skip the
+        # pass: GSPMD cannot partition through the opaque Pallas
+        # custom call.
+        if self.mesh is None:
+            from ..symbol.fusion import maybe_fuse
+            shapes = {n: tuple(d[n].shape)
+                      for d in (arg_dict, aux_dict) for n in d}
+            fused_sym, self.fusion_report = maybe_fuse(
+                self.symbol, shapes, tag="fused_step")
+            if fused_sym is not None:
+                _, self._fwd_loss, _ = build_graph_fns(fused_sym)
         rep = self._rep_sharding()
 
         def _prep(v):
@@ -475,6 +491,17 @@ class FusedSymbolStep:
             self._lr_cache = (0.0, jnp.asarray(0.0, jnp.float32))
         return self._step_jit.lower(*self._state_args(), feed_vals,
                                     self._t_dev, self._lr_cache[1])
+
+    def step_cost(self, feed):
+        """XLA cost analysis of the compiled step as a plain dict
+        (keys like "flops", "bytes accessed"; {} when unavailable).
+        The single unwrap point for the per-computation list some jax
+        versions return — bench.py, tools/perf_sweep.py and the fusion
+        A/B tests all read costs through here."""
+        cost = self.lowered(feed).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return dict(cost) if cost else {}
 
     def load_params(self, arg_dict, aux_dict):
         """Refresh parameter/aux buffers from executor arrays (set_params

@@ -26,9 +26,51 @@ _lib = None
 _tried = False
 
 
+def _needs_build(src):
+    return not os.path.exists(_LIB_PATH) or (
+        os.path.exists(src) and
+        os.path.getmtime(_LIB_PATH) < os.path.getmtime(src))
+
+
 def _build():
-    subprocess.run(["make", "-C", _NATIVE_DIR, "-s"], check=True,
-                   capture_output=True)
+    """Rebuild the library multi-process safely.
+
+    Spawn DataLoader workers all import this module and may race the
+    mtime-triggered rebuild; a worker that dlopens a half-written .so
+    segfaults. So: (1) an ``fcntl.flock`` file lock serializes builders
+    across processes, (2) the compiler writes to a temp file in the
+    same directory which is ``os.rename``d into place — rename is
+    atomic on POSIX, so a concurrent ``CDLL`` sees either the complete
+    old library or the complete new one, never a torn write, and (3)
+    the freshness check re-runs under the lock so waiters don't rebuild
+    what the winner just produced."""
+    import fcntl
+    import tempfile
+    src = os.path.join(_NATIVE_DIR, "recordio.cc")
+    lock_path = _LIB_PATH + ".lock"
+    with open(lock_path, "w") as lf:
+        fcntl.flock(lf, fcntl.LOCK_EX)
+        try:
+            if not _needs_build(src):
+                return
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_NATIVE_DIR)
+            os.close(fd)
+            # make must CREATE the target — the empty mkstemp file
+            # would register as up to date and get renamed as-is.
+            # Reusing the reserved name is safe under the flock.
+            os.unlink(tmp)
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR, "-s",
+                     f"SO={os.path.basename(tmp)}",
+                     os.path.basename(tmp)],
+                    check=True, capture_output=True)
+                os.rename(tmp, _LIB_PATH)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        finally:
+            fcntl.flock(lf, fcntl.LOCK_UN)
 
 
 def get_lib():
@@ -43,10 +85,10 @@ def get_lib():
             # rebuild BEFORE the first dlopen when the source is newer —
             # relinking an already-mapped .so truncates live code pages,
             # and a second CDLL on the same inode returns the stale
-            # handle anyway
-            if not os.path.exists(_LIB_PATH) or (
-                    os.path.exists(src) and
-                    os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)):
+            # handle anyway. _build serializes across processes (flock)
+            # and renames atomically, so spawn workers racing here each
+            # end up dlopening a complete library.
+            if _needs_build(src):
                 _build()
         except Exception:
             # rebuild failed (e.g. no libjpeg on this host): a prebuilt

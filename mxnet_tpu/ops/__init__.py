@@ -16,6 +16,7 @@ from . import linalg  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import contrib  # noqa: F401
 from . import surface  # noqa: F401
+from . import pallas_fused  # noqa: F401
 
 __all__ = ["OpDef", "register_op", "get_op", "has_op", "list_ops", "alias",
            "parse_attr"]

@@ -1,0 +1,426 @@
+"""Pallas fused BN-apply(+ReLU)+matmul kernel and its graph-level op.
+
+docs/perf_analysis.md §3 shows single-chip ResNet-50 training is
+HBM-bandwidth bound: every BN'd activation is touched ~8x per step and
+XLA cannot fuse the normalize/activation pass across the BN statistics
+barrier into the MXU convolution that consumes it. The cuDNN-style fix —
+the one the reference gets from NVIDIA's libraries — is a kernel whose
+PROLOGUE applies BN+ReLU while tiles stream into the matmul,
+eliminating the materialized normalized tensor (one write + one read of
+the full activation) per 1x1 convolution.
+
+``bn_relu_matmul`` is that kernel for the generic (M, K) @ (K, N) case
+(promoted here from tools/pallas_fused_bn_bench.py once the graph-level
+integration landed; the tool now imports it from here). The graph op
+uses the NCHW-native orientation (``_make_nchw_kernel``): per sample
+the (C, H·W) slab of an NCHW activation is contiguous, so contracting
+``w (O, C) @ xhat (C, H·W)`` streams the activation directly — no
+relayout on either side.
+
+``_FusedBNReLUConv`` is the internal graph op the fusion rewrite pass
+(symbol/fusion.py) substitutes for matched ``BatchNorm -> Activation
+(relu) -> Convolution(1x1)`` subgraphs. It preserves exact BatchNorm
+semantics — per-batch statistics in training, moving stats otherwise —
+and mirrors BatchNorm's (out, mean, var) output layout and (…,
+moving_mean, moving_var) input positions so the executors' running-stat
+fold applies unchanged.
+
+Differentiation: ONE custom VJP covers the whole op, statistics
+included — the analytic fused BatchNorm backward (the same coverage as
+cuDNN's BatchNormBackward), which assembles d(data) in a single
+full-tensor pass instead of naive autodiff's separate mean/var chains.
+On TPU the backward recomputes the normalized activation from the raw
+residuals (one elementwise pass — precisely the memory-traffic win);
+off-TPU the interpreter has to materialize it anyway, so it doubles as
+the residual. Off-TPU the whole path runs in interpret mode / stock XLA
+ops, so tier-1 CPU tests exercise the same op, rewrite, and VJP.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+__all__ = ["bn_relu_matmul", "bn_relu_conv_nchw", "select_tiles",
+           "select_conv_tiles", "conv_tile_failure",
+           "fused_bn_relu_conv"]
+
+# output-tile candidates, largest first; TPU-friendly multiples of 8.
+# small trailing candidates keep interpret-mode (CPU test) shapes fusable.
+_BM_CANDIDATES = (1024, 512, 256, 128, 64, 32, 16, 8)
+_BN_CANDIDATES = (512, 256, 128, 64, 32, 16, 8)
+
+
+def select_tiles(m, n):
+    """(bm, bn) output-tile split for an (M, K) @ (K, N) fused matmul,
+    or None when no candidate divides (a truncated grid would leave
+    output tiles uninitialized)."""
+    bm = next((c for c in _BM_CANDIDATES if m % c == 0), None)
+    bn = next((c for c in _BN_CANDIDATES if n % c == 0), None)
+    if bm is None or bn is None:
+        return None
+    return bm, bn
+
+
+def select_conv_tiles(n_out, spatial):
+    """(bo, bs) output tiles for the NCHW-native fused 1×1 conv — bo over
+    output channels, bs over the flattened spatial dim — or None (the
+    rewrite pass's bail-out rule). Output channels must divide by an
+    8-multiple candidate (MXU sublane alignment); the spatial dim may
+    instead be taken whole when small, because odd per-sample extents
+    (7·7=49, 14·14=196) are the NORM mid-network and still block fine."""
+    bo = next((c for c in _BN_CANDIDATES if n_out % c == 0), None)
+    bs = next((c for c in _BM_CANDIDATES if spatial % c == 0), None)
+    if bs is None and spatial <= 1024:
+        bs = int(spatial)
+    if bo is None or bs is None:
+        return None
+    return bo, bs
+
+
+def conv_tile_failure(n_out, spatial):
+    """Which dimension made ``select_conv_tiles`` return None — the
+    fusion report's bail-out reason must point at the right one."""
+    why = []
+    if next((c for c in _BN_CANDIDATES if n_out % c == 0), None) is None:
+        why.append(f"num_filter={n_out} not divisible by 8")
+    if next((c for c in _BM_CANDIDATES if spatial % c == 0), None) \
+            is None and spatial > 1024:
+        why.append(f"spatial={spatial} not divisible by 8 and too "
+                   "large (> 1024) for a whole-row block")
+    return "; ".join(why) or "no tile split fits"
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+def _make_kernel(relu):
+    def _kernel(x_ref, w_ref, scale_ref, shift_ref, o_ref):
+        """One (bm, bn) output tile of the (M, K) @ (K, N) form:
+        normalize (+ReLU) the x tile on the fly (VMEM, fused into the
+        MXU feed) and contract over the whole K."""
+        x = x_ref[...]
+        z = x * scale_ref[...] + shift_ref[...]
+        if relu:
+            z = jnp.maximum(z, 0.0)
+        o_ref[...] = jnp.dot(
+            z.astype(x.dtype), w_ref[...],
+            preferred_element_type=jnp.float32).astype(o_ref.dtype)
+    return _kernel
+
+
+def _make_nchw_kernel(relu):
+    def _kernel(w_ref, x_ref, scale_ref, shift_ref, o_ref):
+        """One (1, bo, bs) output block of the NCHW-native fused conv:
+        normalize (+ReLU) the (1, C, bs) activation block on the fly
+        and contract the (bo, C) weight block over the whole C."""
+        x = x_ref[...]                       # (1, C, bs)
+        z = x * scale_ref[...] + shift_ref[...]  # (C, 1) broadcasts
+        if relu:
+            z = jnp.maximum(z, 0.0)
+        o_ref[...] = jnp.dot(
+            w_ref[...], z[0].astype(x.dtype),
+            preferred_element_type=jnp.float32
+        ).astype(o_ref.dtype)[None]
+    return _kernel
+
+
+def _make_prologue_kernel(relu):
+    def _kernel(x_ref, scale_ref, shift_ref, o_ref):
+        """Whole-array BN-apply(+ReLU) prologue (interpret path): the
+        normalized activation the fused-matmul kernel would stream."""
+        z = x_ref[...] * scale_ref[...] + shift_ref[...]
+        if relu:
+            z = jnp.maximum(z, 0.0)
+        o_ref[...] = z.astype(o_ref.dtype)
+    return _kernel
+
+
+def _conv1x1(xhat, w4):
+    dn = jax.lax.conv_dimension_numbers(xhat.shape, w4.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+    return jax.lax.conv_general_dilated(
+        xhat, w4, (1, 1), [(0, 0), (0, 0)], dimension_numbers=dn)
+
+
+# ---------------------------------------------------------------------------
+# the generic (M, K) @ (K, N) fused matmul (bench tool / kernel tests)
+# ---------------------------------------------------------------------------
+@functools.lru_cache(maxsize=None)
+def _fused_matmul(relu, bm, bn, interpret):
+    from jax.experimental import pallas as pl
+    kernel = _make_kernel(relu)
+
+    @jax.custom_vjp
+    def f(x, w, scale, shift):
+        m, k = x.shape
+        n = w.shape[1]
+        return pl.pallas_call(
+            kernel,
+            grid=(m // bm, n // bn),
+            in_specs=[
+                pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+                pl.BlockSpec((k, bn), lambda i, j: (0, j)),
+                pl.BlockSpec((1, k), lambda i, j: (0, 0)),
+                pl.BlockSpec((1, k), lambda i, j: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+            interpret=interpret,
+        )(x, w, scale.reshape(1, k), shift.reshape(1, k))
+
+    def f_fwd(x, w, scale, shift):
+        # raw-input residuals: the normalized activation is recomputed
+        # in f_bwd (one elementwise pass) rather than written out
+        return f(x, w, scale, shift), (x, w, scale, shift)
+
+    def f_bwd(res, g):
+        x, w, scale, shift = res
+        z = x * scale + shift
+        xhat = (jnp.maximum(z, 0.0) if relu else z).astype(x.dtype)
+        dxhat = jnp.dot(g, w.T, preferred_element_type=jnp.float32)
+        dz = jnp.where(xhat > 0, dxhat, 0.0) if relu else dxhat
+        dx = (dz * scale).astype(x.dtype)
+        dscale = jnp.sum(dz * x, axis=0).astype(scale.dtype)
+        dshift = jnp.sum(dz, axis=0).astype(scale.dtype)
+        dw = jnp.dot(xhat.T, g,
+                     preferred_element_type=jnp.float32).astype(w.dtype)
+        return dx, dw, dscale, dshift
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def bn_relu_matmul(x, w, scale, shift, bm=None, bn=None, relu=True,
+                   interpret=None):
+    """``act(x * scale + shift) @ w`` without materializing the
+    normalized activation. x: (M, K); w: (K, N); scale/shift: (K,) — the
+    folded BN parameters gamma/sqrt(var+eps) and beta - mu*scale.
+
+    Tiles default to ``select_tiles``; explicit bm/bn must divide M/N.
+    ``interpret`` defaults to True off-TPU so the same code path runs in
+    CPU tests. Differentiable via a custom VJP (exact gradients of the
+    composed expression, normalized activation recomputed in backward).
+    """
+    m, k = x.shape
+    n = w.shape[1]
+    # each tile is selected independently, so an explicit bm (or bn)
+    # only needs the OTHER dimension to have a dividing candidate
+    if bm is None:
+        bm = next((c for c in _BM_CANDIDATES if m % c == 0), None)
+        if bm is None:
+            raise ValueError(
+                f"bn_relu_matmul: no tile candidate divides M={m} "
+                "(must be divisible by 8); pad the problem or pass an "
+                "explicit bm")
+    if bn is None:
+        bn = next((c for c in _BN_CANDIDATES if n % c == 0), None)
+        if bn is None:
+            raise ValueError(
+                f"bn_relu_matmul: no tile candidate divides N={n} "
+                "(must be divisible by 8); pad the problem or pass an "
+                "explicit bn")
+    if m % bm or n % bn:
+        raise ValueError(
+            f"bn_relu_matmul needs M % bm == 0 and N % bn == 0 "
+            f"(got M={m}, N={n}, bm={bm}, bn={bn}); pad the problem or "
+            "pass smaller blocks — a truncated grid would leave output "
+            "tiles uninitialized")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _fused_matmul(bool(relu), int(bm), int(bn),
+                         bool(interpret))(x, w, scale, shift)
+
+
+# ---------------------------------------------------------------------------
+# the NCHW-native fused conv forward (used by the graph op)
+# ---------------------------------------------------------------------------
+def bn_relu_conv_nchw(x, w, scale, shift, relu=True, interpret=None):
+    """NCHW-native fused BN-apply(+ReLU)+1×1-conv FORWARD: ``act(x *
+    scale + shift) ⊛ w`` contracted over channels, x (B, C, H, W),
+    w (O, C) → (B, O, H, W). On TPU this is the tiled fused-matmul
+    kernel — the normalized activation never reaches HBM. In interpret
+    mode (CPU tests) the interpreter must materialize it regardless, so
+    the prologue runs as a whole-array Pallas kernel and the stock 1×1
+    convolution does the contraction; pass ``interpret=False`` to force
+    the tiled kernel (still interpretable off-TPU only via
+    ``interpret=True`` in its pallas_call — i.e. don't).
+
+    Forward only; the graph op's custom VJP (analytic fused BN backward)
+    lives in ``_fused_bn_conv_vjp``."""
+    from jax.experimental import pallas as pl
+    b, c, h, w_sp = x.shape
+    s = h * w_sp
+    o = w.shape[0]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if interpret:
+        xhat = pl.pallas_call(
+            _make_prologue_kernel(relu),
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=True,
+        )(x, scale.reshape(1, c, 1, 1), shift.reshape(1, c, 1, 1))
+        return _conv1x1(xhat, w.reshape(o, c, 1, 1)).astype(x.dtype), \
+            xhat
+    tiles = select_conv_tiles(o, s)
+    if tiles is None:
+        raise ValueError(
+            f"bn_relu_conv_nchw: {conv_tile_failure(o, s)}; pad the "
+            "problem")
+    bo, bs = tiles
+    out = pl.pallas_call(
+        _make_nchw_kernel(relu),
+        grid=(b, o // bo, s // bs),
+        in_specs=[
+            pl.BlockSpec((bo, c), lambda g, i, j: (i, 0)),
+            pl.BlockSpec((1, c, bs), lambda g, i, j: (g, 0, j)),
+            pl.BlockSpec((c, 1), lambda g, i, j: (0, 0)),
+            pl.BlockSpec((c, 1), lambda g, i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bo, bs), lambda g, i, j: (g, i, j)),
+        out_shape=jax.ShapeDtypeStruct((b, o, s), x.dtype),
+        interpret=False,
+    )(w, x.reshape(b, c, s), scale.reshape(c, 1), shift.reshape(c, 1))
+    return out.reshape(b, o, h, w_sp), None
+
+
+# ---------------------------------------------------------------------------
+# the graph op: BN(+ReLU)+1×1 conv with the analytic fused backward
+# ---------------------------------------------------------------------------
+@functools.lru_cache(maxsize=None)
+def _fused_bn_conv_vjp(relu, batch_stats, fix_gamma, eps, interpret):
+    """Whole-op custom VJP: (data, gamma, beta, moving_mean, moving_var,
+    w2 (O, C)) -> (out, mean, var). The backward is the ANALYTIC fused
+    BatchNorm backward (cuDNN BatchNormBackward coverage): d(data) is
+    assembled in one full-tensor pass,
+
+        dx = scale·dz + cx·x + c0,   scale/cx/c0 all (C,)-sized,
+
+    instead of naive autodiff's separate mean-/var-chain passes.
+    Running-stat inputs receive no gradient (reference semantics: aux
+    states are not differentiated, batch_norm.cc)."""
+
+    def stats(x):
+        if batch_stats:
+            return jnp.mean(x, axis=(0, 2, 3)), jnp.var(x, axis=(0, 2, 3))
+        return None, None
+
+    def fold(x, gamma, beta, mean, var):
+        g = jnp.ones_like(gamma) if fix_gamma else gamma
+        scale = g * jax.lax.rsqrt(var + eps)
+        return g, scale, beta - mean * scale
+
+    def fwd(x, gamma, beta, mm, mv, w2):
+        mean, var = stats(x)
+        if mean is None:
+            mean, var = mm, mv
+        _, scale, shift = fold(x, gamma, beta, mean, var)
+        out, xhat = bn_relu_conv_nchw(x, w2, scale, shift, relu=relu,
+                                      interpret=interpret)
+        return out, mean, var, xhat
+
+    @jax.custom_vjp
+    def f(x, gamma, beta, mm, mv, w2):
+        out, mean, var, _ = fwd(x, gamma, beta, mm, mv, w2)
+        return out, mean, var
+
+    def f_fwd(x, gamma, beta, mm, mv, w2):
+        out, mean, var, xhat = fwd(x, gamma, beta, mm, mv, w2)
+        # on TPU xhat is None: the backward recomputes it from the raw
+        # residuals (that recompute IS the traffic win); the interpreter
+        # materializes it anyway, so there it doubles as the residual
+        return (out, mean, var), (x, gamma, beta, mean, var, w2, xhat)
+
+    def f_bwd(res, cts):
+        g_out, g_mean, g_var = cts
+        x, gamma, beta, mean, var, w2, xhat = res
+        b, c, h, w_sp = x.shape
+        n = b * h * w_sp
+        o = w2.shape[0]
+        g_eff, scale, shift = fold(x, gamma, beta, mean, var)
+        inv = jax.lax.rsqrt(var + eps)
+        if xhat is None:
+            z = x * scale[:, None, None] + shift[:, None, None]
+            xhat = (jnp.maximum(z, 0.0) if relu else z).astype(x.dtype)
+        # dxhat/dw through XLA's own conv-grad lowering
+        _, conv_vjp = jax.vjp(_conv1x1, xhat, w2.reshape(o, c, 1, 1))
+        dxhat, dw4 = conv_vjp(g_out.astype(xhat.dtype))
+        # relu mask from xhat (xhat > 0 ⟺ z > 0)
+        dz = jnp.where(xhat > 0, dxhat, 0.0) if relu else dxhat
+        # (C,)-sized moments of dz in ONE variadic reduction (a second
+        # pass re-reading dz would double the traffic);
+        # sum(dz·(x-mean)) = s1 - mean·s0
+        dzx = dz * x
+        s0, s1 = jax.lax.reduce(
+            (dz, dzx), (jnp.zeros((), dz.dtype), jnp.zeros((), dzx.dtype)),
+            lambda a, b: (a[0] + b[0], a[1] + b[1]), (0, 2, 3))
+        t = s1 - mean * s0
+        dbeta = s0.astype(beta.dtype)
+        dgamma = jnp.zeros_like(gamma) if fix_gamma \
+            else (t * inv).astype(gamma.dtype)
+        if batch_stats:
+            # analytic training-mode dx — one assembly pass — plus the
+            # (usually zero) cotangents of the mean/var outputs folded
+            # into the same coefficients
+            coef = g_eff * (inv ** 3) * t / n
+            cx = -coef + 2.0 * g_var / n
+            c0 = (-scale * s0 + coef * mean * n) / n + g_mean / n \
+                - 2.0 * mean * g_var / n
+            dx = (dz * scale[:, None, None] + x * cx[:, None, None]
+                  + c0[:, None, None]).astype(x.dtype)
+        else:
+            # moving stats are constants wrt x; their output cotangents
+            # belong to the running-stat inputs, which take no gradient
+            dx = (dz * scale[:, None, None]).astype(x.dtype)
+        return (dx, dgamma, dbeta, jnp.zeros_like(mean),
+                jnp.zeros_like(var),
+                dw4.reshape(o, c).astype(w2.dtype))
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+@register_op("_FusedBNReLUConv", num_outputs=3)
+def fused_bn_relu_conv(data, gamma, beta, moving_mean, moving_var, weight,
+                       bias=None, eps=1e-3, momentum=0.9, fix_gamma=True,
+                       use_global_stats=False, act_type="relu", axis=1,
+                       num_filter=None, no_bias=True, training=False, **kw):
+    """BatchNorm -> Activation(relu) -> Convolution(1x1/s1/p0) as ONE op
+    (internal; substituted by symbol/fusion.py, never user-built).
+
+    Returns (conv_out, batch_mean, batch_var) — BatchNorm's output
+    layout, with moving_mean/moving_var at input positions 3/4 like
+    BatchNorm, so the executors' running-aux fold (Symbol._bn_aux_updates)
+    applies to this op unchanged. ``momentum`` is consumed there, not
+    here."""
+    B, C, H, W = data.shape
+    O = weight.shape[0]
+    batch_stats = bool(training) and not use_global_stats
+    if select_conv_tiles(O, H * W) is None:
+        # shapes the rewrite pass should have bailed on — compute the
+        # reference composition instead of failing mid-trace
+        if batch_stats:
+            mean = jnp.mean(data, axis=(0, 2, 3))
+            var = jnp.var(data, axis=(0, 2, 3))
+        else:
+            mean, var = moving_mean, moving_var
+        g = jnp.ones_like(gamma) if fix_gamma else gamma
+        scale = g * jax.lax.rsqrt(var + eps)
+        shift = beta - mean * scale
+        z = data * scale.reshape(1, C, 1, 1) + shift.reshape(1, C, 1, 1)
+        if act_type == "relu":
+            z = jnp.maximum(z, 0.0)
+        out = _conv1x1(z.astype(data.dtype),
+                       weight.astype(data.dtype).reshape(O, C, 1, 1))
+    else:
+        out, mean, var = _fused_bn_conv_vjp(
+            act_type == "relu", batch_stats, bool(fix_gamma), float(eps),
+            jax.default_backend() != "tpu",
+        )(data, gamma, beta, moving_mean, moving_var,
+          weight.reshape(O, C).astype(data.dtype))
+    if not no_bias and bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out.astype(data.dtype), mean, var

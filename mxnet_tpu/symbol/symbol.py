@@ -316,7 +316,8 @@ class Symbol:
         attrs = {k: parse_attr(v) for k, v in node.attrs.items()
                  if not k.startswith("__")}
         opdef = get_op(node.op)
-        if node.op in ("BatchNorm", "BatchNorm_v1", "Dropout", "RNN"):
+        if node.op in ("BatchNorm", "BatchNorm_v1", "Dropout", "RNN",
+                       "_FusedBNReLUConv"):
             attrs["training"] = training
         if node.op in ("Dropout", "RNN") and training:
             base = rng_key if rng_key is not None \
@@ -338,8 +339,12 @@ class Symbol:
         """[(aux var name, new value)] BatchNorm running-stat folds
         (functional form of the reference's in-place aux mutation,
         batch_norm.cc). ``resolve_var(p)`` -> the variable's current
-        value. Shared by both graph walkers."""
-        if not training or node.op not in ("BatchNorm", "BatchNorm_v1") \
+        value. Shared by both graph walkers. ``_FusedBNReLUConv``
+        (ops/pallas_fused.py) mirrors BatchNorm's layout — moving stats
+        at input positions 3/4, batch stats at outputs 1/2 — exactly so
+        this fold applies to it unchanged."""
+        if not training or node.op not in (
+                "BatchNorm", "BatchNorm_v1", "_FusedBNReLUConv") \
                 or attrs.get("use_global_stats"):
             return []
         momentum = attrs.get("momentum", 0.9)
@@ -487,7 +492,8 @@ class Symbol:
         if training:
             names = set()
             for n in nodes:
-                if n.op not in ("BatchNorm", "BatchNorm_v1"):
+                if n.op not in ("BatchNorm", "BatchNorm_v1",
+                                "_FusedBNReLUConv"):
                     continue
                 attrs = {k: parse_attr(v) for k, v in n.attrs.items()
                          if not k.startswith("__")}
@@ -585,8 +591,6 @@ class Symbol:
         return self._infer_shape_impl(True, *args, **kwargs)
 
     def _infer_shape_impl(self, partial, *args, **kwargs):
-        import jax
-        import jax.numpy as jnp
         arg_names = self.list_arguments()
         aux_names = self.list_auxiliary_states()
         known: Dict[str, tuple] = {}
@@ -596,10 +600,29 @@ class Symbol:
                     known[n] = tuple(s)
         known.update({k: tuple(v) for k, v in kwargs.items()
                       if v is not None})
+        shapes, node_out_shapes = self._propagate_shapes(known)
+        arg_shapes = [shapes.get(n) for n in arg_names]
+        aux_shapes = [shapes.get(n) for n in aux_names]
+        out_shapes = [node_out_shapes.get((id(s._node), s._out_index))
+                      for s in self._output_symbols()]
+        if not partial and any(s is None for s in arg_shapes + out_shapes):
+            missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
+            raise MXNetError(
+                f"infer_shape incomplete; unknown: {missing}. Provide input "
+                "shapes for all data variables.")
+        return arg_shapes, out_shapes, aux_shapes
+
+    def _propagate_shapes(self, known: Dict[str, tuple]):
+        """Best-effort forward shape propagation from known variable
+        shapes — the InferShape walk (reference:
+        infer_graph_attr_pass.cc:325) shared by ``infer_shape`` and the
+        fusion rewrite pass (fusion.py). Returns ``(var_shapes,
+        node_out_shapes)`` where the latter maps ``(id(node), out_idx)``
+        to a shape tuple for every node it could resolve."""
+        import jax
         # propagate forward symbolically: give unknown args a placeholder by
         # deferring — we solve layer-by-layer like the reference's InferShape
         shapes = dict(known)
-        dtypes = {n: np.float32 for n in arg_names + aux_names}
         nodes = self._topo_nodes()
         node_out_shapes: Dict[tuple, tuple] = {}
 
@@ -659,17 +682,7 @@ class Symbol:
 
         for node in nodes:
             try_node(node)
-
-        arg_shapes = [shapes.get(n) for n in arg_names]
-        aux_shapes = [shapes.get(n) for n in aux_names]
-        out_shapes = [node_out_shapes.get((id(s._node), s._out_index))
-                      for s in self._output_symbols()]
-        if not partial and any(s is None for s in arg_shapes + out_shapes):
-            missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
-            raise MXNetError(
-                f"infer_shape incomplete; unknown: {missing}. Provide input "
-                "shapes for all data variables.")
-        return arg_shapes, out_shapes, aux_shapes
+        return shapes, node_out_shapes
 
     def infer_type(self, *args, **kwargs):
         arg_names = self.list_arguments()

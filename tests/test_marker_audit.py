@@ -1,0 +1,68 @@
+"""Marker hygiene for the tier-1 gate (``pytest -m 'not slow'``).
+
+Tier-1 deselects by marker, so marker mistakes silently change CI
+coverage in both directions: an unregistered/typo'd marker never
+matches the filter, and a stray ``slow`` on an interpret-mode case
+drops it from tier-1 entirely. This audit pins:
+
+- the ``slow`` marker is registered in pytest.ini (unregistered marks
+  are warnings, not errors, so a typo would deselect nothing);
+- every ``pytest.mark.*`` used under tests/ is a known marker;
+- the Pallas-fusion interpret-mode suites (test_pallas_fused.py,
+  test_fusion_pass.py) carry no ``slow`` marks — they are the tier-1
+  proof that the TPU kernel code path stays correct.
+"""
+import configparser
+import os
+import re
+
+_TESTS = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_TESTS)
+
+_KNOWN = {
+    # registered project markers
+    "slow",
+    # pytest built-ins
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings",
+}
+
+
+def _mark_uses():
+    uses = {}
+    for name in sorted(os.listdir(_TESTS)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(_TESTS, name)) as f:
+            src = f.read()
+        for m in re.finditer(r"pytest\.mark\.(\w+)", src):
+            uses.setdefault(m.group(1), set()).add(name)
+    return uses
+
+
+def test_slow_marker_is_registered():
+    ini = os.path.join(_ROOT, "pytest.ini")
+    assert os.path.exists(ini), "pytest.ini with marker registry missing"
+    cp = configparser.ConfigParser()
+    cp.read(ini)
+    markers = cp.get("pytest", "markers", fallback="")
+    assert re.search(r"^\s*slow\s*:", markers, re.M), \
+        "the 'slow' marker must be registered (tier-1 filters on it)"
+
+
+def test_only_known_markers_used():
+    unknown = {m: files for m, files in _mark_uses().items()
+               if m not in _KNOWN}
+    assert not unknown, (
+        f"unregistered pytest markers {unknown} — a typo'd mark "
+        "silently escapes the tier-1 '-m not slow' filter; register it "
+        "in pytest.ini and this audit")
+
+
+def test_pallas_interpret_suites_run_in_tier1():
+    uses = _mark_uses().get("slow", set())
+    protected = {"test_pallas_fused.py", "test_fusion_pass.py"}
+    marked = protected & uses
+    assert not marked, (
+        f"{marked} must not be marked slow: their interpret-mode cases "
+        "are tier-1's only coverage of the Pallas fusion code path")

@@ -110,6 +110,137 @@ def test_eval_score_uses_sync_path():
     assert abs(s - manual) < 1e-9
 
 
+def test_partial_reattach_no_double_count():
+    """r6 regression (metric_device.inline_update partial re-attach): a
+    leaf whose in-step window was flushed during re-attach must NOT be
+    counted again by the final sync update for the same batch. 3-batch
+    scenario: the leaf runs standalone for two batches, then joins a
+    composite on the third; num_inst and value must match the sync
+    path."""
+    mx.random.seed(0)
+    np.random.seed(0)
+    mod = _mod(20)
+    acc = mx.metric.Accuracy()
+    ref = mx.metric.Accuracy()
+
+    def step():
+        b = _batch(20)
+        mod.forward(b, is_train=True)
+        mod.backward()
+        mod.update()
+        return b
+
+    for _ in range(2):
+        b = step()
+        mod.update_metric(acc, b.label)
+        ref.update_dict({"softmax_label": b.label[0]},
+                        {"softmax_output": mod.get_outputs()[0]})
+    # third batch: the SAME metric object joins a composite — its ref
+    # is still valid (flush covers this batch), the TopK leaf is new
+    topk = mx.metric.TopKAccuracy(top_k=3)
+    topk_ref = mx.metric.TopKAccuracy(top_k=3)
+    em = mx.metric.CompositeEvalMetric([acc, topk])
+    b = step()
+    mod.update_metric(em, b.label)
+    ld = {"softmax_label": b.label[0]}
+    pd = {"softmax_output": mod.get_outputs()[0]}
+    ref.update_dict(ld, pd)
+    topk_ref.update_dict(ld, pd)
+    acc.get()  # fold any open window before inspecting counters
+    assert acc.num_inst == ref.num_inst == 60
+    assert abs(acc.get()[1] - ref.get()[1]) < 1e-9
+    assert abs(topk.get()[1] - topk_ref.get()[1]) < 1e-9
+
+
+def test_partial_reattach_with_gap_discards():
+    """r6 code-review regression: a still-valid leaf whose window has a
+    GAP (steps ran without update_metric) must discard that window on
+    partial re-attach — the same unattributable-window rule as the
+    all-valid branch — not flush it and credit batches never
+    submitted."""
+    mx.random.seed(0)
+    np.random.seed(0)
+    mod = _mod(20)
+    acc = mx.metric.Accuracy()
+
+    def step():
+        b = _batch(20)
+        mod.forward(b, is_train=True)
+        mod.backward()
+        mod.update()
+        return b
+
+    b = step()
+    mod.update_metric(acc, b.label)          # batch 1 counted (attach)
+    step()                                   # batches 2-3: NO
+    step()                                   # update_metric — a gap
+    em = mx.metric.CompositeEvalMetric(
+        [acc, mx.metric.TopKAccuracy(top_k=3)])
+    b = step()
+    mod.update_metric(em, b.label)           # batch 4 via composite
+    acc.get()
+    # batches 1 and 4 only: the gap window (2-3) is not attributable
+    assert acc.num_inst == 40
+
+
+def test_double_update_call_flushes_not_discards():
+    """r6 regression (metric_device.inline_update double call): calling
+    update_metric twice for the SAME batch (no gap) must fold the open
+    in-step window before the slot is released — the old discard()
+    silently lost every step since the last flush. Reference per-call
+    semantics: the doubled batch counts twice on both paths."""
+    mx.random.seed(0)
+    np.random.seed(0)
+    mod = _mod(20)
+    acc = mx.metric.Accuracy()
+    ref = mx.metric.Accuracy()
+    b = None
+    for _ in range(3):
+        b = _batch(20)
+        mod.forward(b, is_train=True)
+        mod.backward()
+        mod.update()
+        mod.update_metric(acc, b.label)
+        ref.update_dict({"softmax_label": b.label[0]},
+                        {"softmax_output": mod.get_outputs()[0]})
+    # second update_metric for the SAME batch: window (batches 2-3)
+    # must flush, then the batch counts once more synchronously
+    mod.update_metric(acc, b.label)
+    ref.update_dict({"softmax_label": b.label[0]},
+                    {"softmax_output": mod.get_outputs()[0]})
+    acc.get()
+    assert acc.num_inst == ref.num_inst == 80
+    assert abs(acc.get()[1] - ref.get()[1]) < 1e-9
+
+
+def test_mixed_composite_states_settle_per_leaf():
+    """r6 code-review regression: when one composite leaf was also
+    updated standalone this batch (double call) while its sibling is
+    contiguous, each must settle under ITS OWN contract — the sibling's
+    fully-attributable window must not be discarded."""
+    mx.random.seed(0)
+    np.random.seed(0)
+    mod = _mod(20)
+    acc = mx.metric.Accuracy()
+    topk = mx.metric.TopKAccuracy(top_k=3)
+    em = mx.metric.CompositeEvalMetric([acc, topk])
+    b = None
+    for i in range(3):
+        b = _batch(20)
+        mod.forward(b, is_train=True)
+        mod.backward()
+        mod.update()
+        if i == 2:
+            # acc ONLY first on the last batch: when the composite call
+            # follows, acc is a double call while topk is contiguous
+            mod.update_metric(acc, b.label)
+        mod.update_metric(em, b.label)
+    acc.get()
+    topk.get()
+    assert topk.num_inst == 60      # 3 batches, nothing dropped
+    assert acc.num_inst == 80       # 3 batches + the repeat of batch 3
+
+
 def test_composite_name_filters_respected():
     """CompositeEvalMetric(output_names=...) filtering must match the
     sync path (r5 code-review finding)."""

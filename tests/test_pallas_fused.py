@@ -1,7 +1,8 @@
-"""Correctness of the fused BN-apply+ReLU+matmul Pallas kernel
-(tools/pallas_fused_bn_bench.py — the identified path past the v5e HBM
-roofline, docs/perf_analysis.md §3). Runs the real kernel on TPU and
-interpret mode elsewhere."""
+"""Correctness of the fused BN-apply(+ReLU)+matmul Pallas kernels
+(mxnet_tpu/ops/pallas_fused.py — the path past the v5e HBM roofline,
+docs/perf_analysis.md §3/§5). Runs the real kernels on TPU and interpret
+mode elsewhere; the graph-level rewrite that routes BN→ReLU→1×1-conv
+subgraphs onto them is covered by tests/test_fusion_pass.py."""
 import os
 import sys
 
@@ -12,21 +13,24 @@ sys.path.insert(0, os.path.join(os.path.dirname(
     os.path.abspath(__file__)), "..", "tools"))
 
 
-def test_bn_relu_matmul_matches_unfused():
-    import jax
+def _inputs(m=512, k=64, n=256):
     import jax.numpy as jnp
-    import functools
-    from jax.experimental import pallas as pl
-    from pallas_fused_bn_bench import _kernel, unfused
-
-    on_tpu = jax.devices()[0].platform == "tpu"
-    m, k, n = 512, 64, 256
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randn(m, k).astype(np.float32))
     w = jnp.asarray(rng.randn(k, n).astype(np.float32) * 0.1)
     scale = jnp.asarray(rng.rand(k).astype(np.float32) + 0.5)
     shift = jnp.asarray(rng.randn(k).astype(np.float32) * 0.1)
+    return x, w, scale, shift
 
+
+def test_bn_relu_matmul_matches_unfused():
+    import jax
+    from jax.experimental import pallas as pl
+    from pallas_fused_bn_bench import _kernel, unfused
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    m, k, n = 512, 64, 256
+    x, w, scale, shift = _inputs(m, k, n)
     bm, bn = 256, 128
     out = pl.pallas_call(
         _kernel,
@@ -42,5 +46,80 @@ def test_bn_relu_matmul_matches_unfused():
         interpret=not on_tpu,
     )(x, w, scale.reshape(1, k), shift.reshape(1, k))
     ref = unfused(x, w, scale, shift)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_bn_relu_matmul_api_and_grad():
+    """The promoted public API: auto tile selection, the custom VJP's
+    gradients against autodiff of the unfused expression."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas_fused import bn_relu_matmul
+    from pallas_fused_bn_bench import unfused
+
+    x, w, scale, shift = _inputs()
+    out = bn_relu_matmul(x, w, scale, shift)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(unfused(x, w, scale, shift)),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_f(*a):
+        return jnp.sum(bn_relu_matmul(*a) ** 2)
+
+    def loss_u(*a):
+        return jnp.sum(unfused(*a).astype(jnp.float32) ** 2)
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2, 3))(x, w, scale, shift)
+    gu = jax.grad(loss_u, argnums=(0, 1, 2, 3))(x, w, scale, shift)
+    for name, a, b in zip(("x", "w", "scale", "shift"), gf, gu):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4,
+                                   err_msg=f"grad {name}")
+
+
+def test_bn_relu_matmul_rejects_bad_tiles():
+    from mxnet_tpu.ops.pallas_fused import bn_relu_matmul
+    x, w, scale, shift = _inputs()
+    with pytest.raises(ValueError, match="M % bm"):
+        bn_relu_matmul(x, w, scale, shift, bm=100, bn=128)
+
+
+def test_nchw_kernel_tiled_interpret_matches_reference():
+    """The NCHW-native tiled kernel (the TPU lowering of the graph op),
+    exercised with a real grid in interpret mode, against the plain
+    composition."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from mxnet_tpu.ops.pallas_fused import (_make_nchw_kernel,
+                                            select_conv_tiles)
+
+    B, C, H, W, O = 2, 8, 4, 8, 16
+    s = H * W
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, C, H, W).astype(np.float32))
+    w = jnp.asarray(rng.randn(O, C).astype(np.float32) * 0.1)
+    scale = jnp.asarray(rng.rand(C).astype(np.float32) + 0.5)
+    shift = jnp.asarray(rng.randn(C).astype(np.float32) * 0.1)
+    bo, bs = select_conv_tiles(O, s)
+    assert (bo, bs) == (16, 32)
+    out = pl.pallas_call(
+        _make_nchw_kernel(relu=True),
+        grid=(B, O // bo, s // bs),
+        in_specs=[
+            pl.BlockSpec((bo, C), lambda g, i, j: (i, 0)),
+            pl.BlockSpec((1, C, bs), lambda g, i, j: (g, 0, j)),
+            pl.BlockSpec((C, 1), lambda g, i, j: (0, 0)),
+            pl.BlockSpec((C, 1), lambda g, i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bo, bs), lambda g, i, j: (g, i, j)),
+        out_shape=jax.ShapeDtypeStruct((B, O, s), x.dtype),
+        interpret=jax.devices()[0].platform != "tpu",
+    )(w, x.reshape(B, C, s), scale.reshape(C, 1), shift.reshape(C, 1))
+    ref = jnp.einsum(
+        "oc,bcs->bos", w,
+        jnp.maximum(x.reshape(B, C, s) * scale[:, None]
+                    + shift[:, None], 0.0))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)

@@ -58,6 +58,19 @@ CASES = {
         inputs=[_img((2, 4, 5, 5)), _pos((4,), 1), _signed((4,), 2),
                 _signed((4,), 3), _pos((4,), 4)],
         attrs=dict(fix_gamma=False), grad_args=[0, 1, 2]),
+    "_FusedBNReLUConv": dict(
+        # BN(+1x1-conv) fused op (ops/pallas_fused.py): 8-divisible
+        # channels so the Pallas path (analytic custom VJP) is the one
+        # checked. Finite differences need the smooth bare-BN variant
+        # (act_type=None) — the relu kink makes directional FD
+        # unreliable; the relu path's gradient is pinned against
+        # autodiff by tests/test_fusion_pass.py instead.
+        inputs=[_img((2, 8, 4, 4)), _pos((8,), 1), _signed((8,), 2),
+                _signed((8,), 3), _pos((8,), 4),
+                _signed((16, 8, 1, 1), 5)],
+        attrs=dict(fix_gamma=False, num_filter=16, no_bias=True,
+                   training=True, act_type=None),
+        grad_args=[0, 1, 2, 5], tol=(5e-2, 5e-3)),
     "LayerNorm": dict(
         inputs=[_signed((3, 6), 0), _pos((6,), 1), _signed((6,), 2)]),
     "InstanceNorm": dict(

@@ -1,29 +1,23 @@
-"""Pallas fused BN-apply+ReLU+matmul kernel (+ best-effort microbench).
+"""Microbench for the Pallas fused BN-apply+ReLU+matmul kernel.
 
-docs/perf_analysis.md shows single-chip ResNet-50 training is
-HBM-bandwidth-bound: every BN'd activation is touched ~8x per step, and
-XLA cannot fuse the normalize/activation pass into the MXU convolution
-that consumes it. The cuDNN-style fix is a kernel whose PROLOGUE applies
-BN+ReLU while tiles stream into the matmul — eliminating the
-materialized normalized tensor (one write + one read of the full
-activation) per 1x1 convolution. ``bn_relu_matmul`` below is that kernel
-for the 1x1-conv-as-matmul case; correctness is pinned by
-tests/test_pallas_fused.py (interpret mode off-TPU, real kernel on TPU).
+The kernel itself was promoted into ``mxnet_tpu/ops/pallas_fused.py``
+(round 6) and is wired into the compiled training step by the
+graph-rewrite fusion pass (mxnet_tpu/symbol/fusion.py, flag
+MXTPU_PALLAS_FUSION); this tool remains the standalone best-effort
+microbench of the raw (M, K) @ (K, N) kernel.
 
 MEASUREMENT CAVEAT: standalone kernel timings through this environment's
 tunneled runtime are unreliable — block_until_ready must be "armed" by a
 host fetch, lax.scan bodies lower with conservative scheduling, and
 XLA's algebraic simplifier collapses linear-op repetition chains. The
-authoritative performance numbers are whole-step (bench.py + the xplane
-profile in tools/step_profile.py); whole-step integration of this kernel
-(rewriting the symbolic executor's conv+BN pattern) is the identified
-next step and was deliberately not rushed into the flagship path.
+authoritative performance numbers are whole-step (bench.py, which also
+records the fused-vs-unfused ``bytes accessed`` A/B, and the xplane
+profile in tools/step_profile.py).
 
 Usage: python tools/pallas_fused_bn_bench.py [M] [K] [N]
 """
 from __future__ import annotations
 
-import functools
 import os
 import sys
 import time
@@ -35,46 +29,13 @@ sys.path.insert(0, os.path.join(os.path.dirname(
 
 import jax                                     # noqa: E402
 import jax.numpy as jnp                        # noqa: E402
-from jax.experimental import pallas as pl      # noqa: E402
 
+from mxnet_tpu.ops.pallas_fused import (       # noqa: E402,F401
+    bn_relu_matmul, select_tiles, _make_kernel)
 
-def _kernel(x_ref, w_ref, scale_ref, shift_ref, o_ref):
-    """One (bm, bn) output tile: normalize+ReLU the x tile on the fly
-    (VMEM, fused into the MXU feed) and contract over the whole K."""
-    x = x_ref[...]
-    xhat = jnp.maximum(
-        x * scale_ref[...] + shift_ref[...], 0.0).astype(x.dtype)
-    o_ref[...] = jnp.dot(
-        xhat, w_ref[...],
-        preferred_element_type=jnp.float32).astype(o_ref.dtype)
-
-
-@functools.partial(jax.jit, static_argnames=("bm", "bn"))
-def bn_relu_matmul(x, w, scale, shift, bm=1024, bn=256):
-    """relu(x * scale + shift) @ w without materializing the normalized
-    activation. x: (M, K); w: (K, N); scale/shift: (K,) — the folded
-    BN parameters gamma/sqrt(var+eps) and beta - mu*scale."""
-    m, k = x.shape
-    _, n = w.shape
-    if m % bm or n % bn:
-        raise ValueError(
-            f"bn_relu_matmul needs M % bm == 0 and N % bn == 0 "
-            f"(got M={m}, N={n}, bm={bm}, bn={bn}); pad the problem or "
-            "pass smaller blocks — a truncated grid would leave output "
-            "tiles uninitialized")
-    grid = (m // bm, n // bn)
-    return pl.pallas_call(
-        _kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
-            pl.BlockSpec((k, bn), lambda i, j: (0, j)),
-            pl.BlockSpec((1, k), lambda i, j: (0, 0)),
-            pl.BlockSpec((1, k), lambda i, j: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
-    )(x, w, scale.reshape(1, k), shift.reshape(1, k))
+# back-compat alias: the raw one-tile kernel body (tests and downstream
+# scripts imported ``_kernel`` from this tool before the promotion)
+_kernel = _make_kernel(relu=True)
 
 
 @jax.jit
@@ -85,7 +46,7 @@ def unfused(x, w, scale, shift):
 
 
 def _time(f, x, w, scale, shift, inner=16, reps=5):
-    """Per-application time with the op repeated INSIDE one jitted scan
+    """Per-application time with the op repeated INSIDE one jitted chain
     (a lone kernel launch through this environment's tunneled runtime
     pays a ~4 ms dispatch floor that would swamp a sub-ms op). The input
     is perturbed per iteration so XLA cannot hoist the op out of the

@@ -1,7 +1,13 @@
 """Quick A/B throughput sweep of the fused Module step on the real chip.
 
-Usage: python tools/perf_sweep.py "std:128" "s2d:128" "s2d:256" ...
-Each spec is stem:batch. Prints img/s and implied model-FLOPs MFU.
+Usage: python tools/perf_sweep.py "std:128" "s2d:128" "s2d:128:nofused" ...
+Each spec is stem:batch[:fused|nofused] — the optional third field
+forces the Pallas BN(+ReLU)->1x1-conv fusion pass on/off
+(MXTPU_PALLAS_FUSION; default auto = on for TPU), so
+``s2d:128 s2d:128:nofused`` is the fused-vs-unfused A/B. Prints img/s,
+implied model-FLOPs MFU, the pass's rewritten-site count, and XLA cost
+analysis' "bytes accessed" for the compiled step (the HBM-traffic
+number the fusion exists to cut).
 """
 from __future__ import annotations
 
@@ -51,16 +57,39 @@ def measure(stem, batch, steps=30):
     step = dt / steps
     img_s = batch / step
     mfu = MODEL_FLOPS_PER_IMG * batch / step / PEAK
-    return img_s, step, mfu
+    rep = model._fused.fusion_report
+    sites = len(rep["sites"]) if rep else 0
+    gbytes = None
+    try:
+        fused = model._fused
+        b0 = batches[0]
+        feed = {fused.data_names[0]: b0.data[0].data,
+                fused.label_names[0]: b0.label[0].data}
+        by = float(fused.step_cost(feed).get("bytes accessed", 0.0))
+        gbytes = by / 1e9 if by > 0 else None
+    except Exception:
+        pass
+    return img_s, step, mfu, sites, gbytes
 
 
 def main():
+    from mxnet_tpu import config
     specs = sys.argv[1:] or ["std:128", "s2d:128"]
     for spec in specs:
-        stem, batch = spec.split(":")
-        img_s, step, mfu = measure(stem, int(batch))
-        print(f"{spec:>10}: {img_s:8.1f} img/s  step={step*1e3:6.2f} ms  "
-              f"mfu={mfu:.4f}", flush=True)
+        parts = spec.split(":")
+        stem, batch = parts[0], int(parts[1])
+        flag = os.environ.get("MXTPU_PALLAS_FUSION")  # keep as-is
+        if len(parts) > 2:
+            if parts[2] not in ("fused", "nofused"):
+                sys.exit(f"bad spec '{spec}': third field must be "
+                         "'fused' or 'nofused'")
+            flag = "1" if parts[2] == "fused" else "0"
+        with config.override("MXTPU_PALLAS_FUSION", flag):
+            img_s, step, mfu, sites, gbytes = measure(stem, batch)
+        gb = f"{gbytes:6.2f} GB/step" if gbytes else "   n/a"
+        print(f"{spec:>18}: {img_s:8.1f} img/s  step={step*1e3:6.2f} ms"
+              f"  mfu={mfu:.4f}  fused_sites={sites:3d}  bytes={gb}",
+              flush=True)
 
 
 if __name__ == "__main__":
