@@ -60,6 +60,8 @@ from . import kvstore_server
 from . import test_utils
 from . import visualization
 from . import visualization as viz
+from . import serving
+from .serving import serving_report
 from . import contrib
 from . import gluon
 from . import rnn

@@ -108,6 +108,15 @@ register("MXTPU_PALLAS_FUSION", "auto", str,
          "Graph-rewrite pass routing BN(+ReLU)->1x1-conv subgraphs "
          "through the Pallas fused kernel (symbol/fusion.py): 1/0 force "
          "on/off, auto = on for TPU backends, off elsewhere")
+register("MXTPU_SERVING_BUCKETS", "1,8,64", str,
+         "Default batch buckets for serving.Predictor: requests pad to "
+         "the nearest bucket so arbitrary sizes never retrace")
+register("MXTPU_SERVING_MAX_WAIT_US", 2000, int,
+         "DynamicBatcher coalescing window: how long the first queued "
+         "request waits for company before its micro-batch launches")
+register("MXTPU_SERVING_MAX_QUEUE", 256, int,
+         "DynamicBatcher admission bound in queued ROWS; submits past "
+         "it fail fast with serving.Overloaded (load shedding)")
 
 
 def _autostart_profiler():

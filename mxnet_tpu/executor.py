@@ -285,8 +285,13 @@ class Executor:
             shapes = {n: tuple(a.shape) for n, a in
                       list(self.arg_dict.items()) +
                       list(self.aux_dict.items())}
+            # inference-only binds (grad_req all 'null' — predict/score
+            # and serving executors) report under their own tag so
+            # fusion_report() shows the predict program is covered too
+            infer_only = all(r == "null" for r in self.grad_req.values())
             fused_sym, self._fusion_report = maybe_fuse(
-                self._symbol, shapes, tag="executor")
+                self._symbol, shapes,
+                tag="executor_infer" if infer_only else "executor")
             if fused_sym is not None:
                 sym = fused_sym
         fwd, fwd_loss, loss_specs = build_graph_fns(sym)

@@ -638,6 +638,16 @@ class Module(BaseModule):
             with open(fname, "rb") as f:
                 self._updater.set_states(f.read())
 
+    def as_predictor(self, buckets=None, compute_dtype=None, **kwargs):
+        """Freeze this trained module into a ``serving.Predictor`` —
+        inference-only jitted program per batch bucket, params staged
+        once, fusion pass applied (serving/predictor.py). The module
+        keeps training; the predictor owns copies."""
+        from ..serving import Predictor
+        return Predictor.from_module(self, buckets=buckets,
+                                     compute_dtype=compute_dtype,
+                                     **kwargs)
+
     def reshape(self, data_shapes, label_shapes=None):
         """(reference: module.py:448)"""
         assert self.binded

@@ -223,6 +223,23 @@ class Domain:
         return self.name
 
 
+_TRACE_ANN = None          # resolved jax.profiler.TraceAnnotation class
+
+
+def _trace_annotation_cls():
+    """Resolve (once) the TraceAnnotation class. Spans run in serving's
+    per-micro-batch hot loop, so the import + attribute walk must not
+    repeat per call; ``False`` caches a failed resolution."""
+    global _TRACE_ANN
+    if _TRACE_ANN is None:
+        try:
+            import jax
+            _TRACE_ANN = jax.profiler.TraceAnnotation
+        except Exception:
+            _TRACE_ANN = False
+    return _TRACE_ANN or None
+
+
 class _Span:
     """start()/stop() span recorded into the aggregate table and, when a
     jax trace is running, as a TraceAnnotation on the device timeline."""
@@ -235,12 +252,14 @@ class _Span:
 
     def start(self):
         self._t0 = time.perf_counter()
-        try:
-            import jax
-            self._ann = jax.profiler.TraceAnnotation(
-                f"{self.domain}::{self.name}")
-            self._ann.__enter__()
-        except Exception:
+        cls = _trace_annotation_cls()
+        if cls is not None:
+            try:
+                self._ann = cls(f"{self.domain}::{self.name}")
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        else:
             self._ann = None
         return self
 

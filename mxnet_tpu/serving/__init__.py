@@ -1,0 +1,85 @@
+"""Inference serving: bucketed compiled predictors + dynamic batching.
+
+The training subsystems (fused Module step, fusion pass, device metrics)
+make the hot TRAINING loop one XLA program; this package does the same
+for serving. The reference's inference story was ``Module.predict``'s
+eager per-batch loop plus the C Predict API (reference:
+src/c_api/c_predict_api.cc — frozen symbol + params, one executor per
+input shape); TVM's deployment stack showed that ahead-of-time compiled,
+cached artifacts are what serving throughput actually comes from. Here:
+
+- ``Predictor`` (predictor.py) freezes a trained Module/Symbol into an
+  inference-only jitted program — params staged on device once, the
+  ``MXTPU_PALLAS_FUSION`` graph rewrite applied to the predict program,
+  optional bf16 compute, donated input buffers — behind a
+  shape-bucketed compile cache: requests pad to a small set of batch
+  buckets (bucketing_module-style), so arbitrary request sizes never
+  retrace.
+- ``DynamicBatcher`` (batcher.py) coalesces concurrent requests into
+  bucket-sized micro-batches (``max_batch``/``max_wait_us``), splits
+  results back per request, enforces per-request deadlines, and sheds
+  load past a queue bound with an explicit ``Overloaded`` error instead
+  of hanging.
+- ``serving_report()`` aggregates per-bucket latency percentiles, queue
+  depth, batch occupancy, and retrace counters from every live
+  Predictor/DynamicBatcher; the same spans also feed the
+  ``mxnet_tpu.profiler`` aggregate table under the ``serving`` domain.
+
+Knobs default from ``MXTPU_SERVING_*`` env vars (mxnet_tpu/config.py,
+docs/faq/env_var.md).
+"""
+from __future__ import annotations
+
+import weakref
+
+from ..base import MXNetError
+
+__all__ = ["Predictor", "DynamicBatcher", "ServingError", "Overloaded",
+           "DeadlineExceeded", "serving_report"]
+
+
+class ServingError(MXNetError):
+    """Base class for serving-path failures."""
+
+
+class Overloaded(ServingError):
+    """Request rejected at admission: the batcher queue is at its bound.
+
+    Load-shedding semantics: raised IMMEDIATELY at submit() — an
+    overloaded server must fail fast so the client can back off or
+    retry elsewhere, never queue unboundedly or hang."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline expired before its micro-batch ran."""
+
+
+# live Predictor/DynamicBatcher instances; serving_report() walks these.
+# WeakSets so a dropped server never pins device buffers.
+_PREDICTORS: "weakref.WeakSet" = weakref.WeakSet()
+_BATCHERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _register_predictor(p):
+    _PREDICTORS.add(p)
+
+
+def _register_batcher(b):
+    _BATCHERS.add(b)
+
+
+def serving_report(reset: bool = False) -> dict:
+    """Aggregate serving observability: one entry per live Predictor
+    (per-bucket compile/call/pad counters, retraces) and per live
+    DynamicBatcher (per-bucket p50/p99 latency, queue depth, batch
+    occupancy, shed/deadline counters). ``reset=True`` clears the
+    latency windows and counters after reading."""
+    return {
+        "predictors": [p.report(reset=reset) for p in list(_PREDICTORS)],
+        "batchers": [b.report(reset=reset) for b in list(_BATCHERS)],
+    }
+
+
+from .predictor import Predictor           # noqa: E402
+from .batcher import DynamicBatcher        # noqa: E402
+from . import loadgen                      # noqa: E402

@@ -1,0 +1,372 @@
+"""Dynamic micro-batcher: coalesce concurrent requests onto the buckets.
+
+Serving traffic arrives as many small concurrent requests; the chip
+wants few large batches. The batcher is the piece between: a
+thread-safe queue that coalesces requests up to ``max_batch`` rows or
+``max_wait_us`` (whichever first), pads the coalesced rows to the
+nearest Predictor bucket, runs ONE compiled program, and splits the
+outputs back per request — the standard dynamic-batching design of
+production model servers (TF-Serving/Triton), sized here by the same
+bucket set that keys the compile cache so batching never retraces.
+
+Robustness is part of the contract, not an add-on:
+
+- **admission control / load-shedding**: ``submit`` rejects with
+  ``Overloaded`` the moment queued rows exceed ``max_queue`` — a bounded
+  queue with a fast, explicit failure beats an unbounded one that turns
+  overload into timeouts for every client;
+- **per-request deadlines**: a request whose deadline expires while
+  queued completes with ``DeadlineExceeded`` without occupying a batch
+  slot (running it anyway would waste chip time on an answer the client
+  already abandoned);
+- **warmup**: ``start()`` compiles every bucket before the first
+  request, so no live request ever pays an XLA trace.
+
+Observability: per-bucket latency reservoirs (p50/p99), queue depth,
+batch occupancy, shed/deadline counters — read through
+``mxnet_tpu.serving.serving_report()``; each micro-batch also runs
+under a ``mxnet_tpu.profiler`` ``serving`` domain span so the
+aggregate table and device traces see the same boundaries.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from .. import config
+from .. import profiler
+from ..base import MXNetError
+from . import DeadlineExceeded, Overloaded, _register_batcher
+
+__all__ = ["DynamicBatcher", "ServingFuture"]
+
+_LAT_WINDOW = 2048  # per-bucket latency samples kept (ring buffer)
+_DEADLINE_SLACK_S = 0.002  # launch this early so an at-deadline
+                           # request is still live when collected
+
+
+class ServingFuture:
+    """Completion handle for one submitted request."""
+
+    __slots__ = ("_event", "_result", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def _complete(self, result=None, error=None):
+        self._result = result
+        self._error = error
+        self._event.set()
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving request still pending")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _Request:
+    __slots__ = ("arrays", "rows", "future", "deadline", "t_submit")
+
+    def __init__(self, arrays, rows, future, deadline):
+        self.arrays = arrays
+        self.rows = rows
+        self.future = future
+        self.deadline = deadline
+        self.t_submit = time.perf_counter()
+
+
+class DynamicBatcher:
+    """Coalesce concurrent requests through a ``Predictor``.
+
+    Parameters
+    ----------
+    predictor : Predictor
+    max_batch : int, optional
+        Row cap per micro-batch (default: the predictor's largest
+        bucket; may not exceed it).
+    max_wait_us : int, optional
+        How long the first queued request waits for company before the
+        micro-batch launches anyway (default MXTPU_SERVING_MAX_WAIT_US).
+    max_queue : int, optional
+        Queued-row bound for admission control (default
+        MXTPU_SERVING_MAX_QUEUE).
+    name : str
+        Label for profiler spans and serving_report entries.
+    """
+
+    def __init__(self, predictor, max_batch=None, max_wait_us=None,
+                 max_queue=None, name="serving"):
+        self.predictor = predictor
+        self.max_batch = int(max_batch) if max_batch is not None \
+            else predictor.max_batch
+        if self.max_batch > predictor.max_batch:
+            raise MXNetError(
+                f"max_batch={self.max_batch} exceeds the largest "
+                f"predictor bucket ({predictor.max_batch})")
+        self.max_wait_us = int(max_wait_us) if max_wait_us is not None \
+            else int(config.get("MXTPU_SERVING_MAX_WAIT_US", 2000))
+        self.max_queue = int(max_queue) if max_queue is not None \
+            else int(config.get("MXTPU_SERVING_MAX_QUEUE", 256))
+        self.name = name
+        self._domain = profiler.Domain("serving")
+        self._tasks = {b: self._domain.new_task(f"{name}::bucket{b}")
+                       for b in predictor.buckets}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue = []          # FIFO of _Request
+        self._queued_rows = 0
+        self._running = False
+        self._thread = None
+        # observability (guarded by _lock)
+        self._lat = {b: [] for b in predictor.buckets}  # seconds
+        self._occ_rows = {b: 0 for b in predictor.buckets}
+        self._occ_batches = {b: 0 for b in predictor.buckets}
+        self._shed = 0
+        self._deadline_missed = 0
+        self._served = 0
+        _register_batcher(self)
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self):
+        """Warm every bucket (compile now, not on a live request) and
+        start the batching thread."""
+        if self._running:
+            return self
+        if self._thread is not None and self._thread.is_alive():
+            # a previous stop() timed out mid-drain; a second loop
+            # racing the same queue would double-serve requests
+            raise MXNetError(
+                f"DynamicBatcher '{self.name}' is still draining from "
+                "a previous stop(); call stop() again first")
+        self.predictor.warmup()
+        self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name=f"{self.name}-batcher",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain=True):
+        """Stop the batching thread. ``drain=True`` serves what's
+        queued first; otherwise queued requests fail with
+        ``Overloaded``. Raises (leaving the thread draining, and
+        ``start()`` refused until it exits) if the drain exceeds 60s."""
+        with self._cond:
+            if not self._running:
+                if self._thread is None or not self._thread.is_alive():
+                    self._thread = None
+                    return
+                # a previous stop() timed out: fall through to re-join
+            elif not drain:
+                for r in self._queue:
+                    r.future._complete(error=Overloaded(
+                        "server shutting down"))
+                self._queue.clear()
+                self._queued_rows = 0
+            self._running = False
+            self._cond.notify_all()
+        t = self._thread
+        t.join(timeout=60)
+        if t.is_alive():
+            raise MXNetError(
+                f"DynamicBatcher '{self.name}' did not finish draining "
+                "within 60s; it keeps draining in the background — call "
+                "stop() again to re-join, or stop(drain=False) next "
+                "time to shed instead")
+        self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- client surface -------------------------------------------------------
+    def submit(self, data, deadline_ms=None):
+        """Enqueue one request; returns a ``ServingFuture``.
+
+        ``data``: array or dict name -> array with a leading batch dim
+        of at most ``max_batch`` rows. ``deadline_ms``: latency budget —
+        if the micro-batch can't launch in time the future completes
+        with ``DeadlineExceeded``."""
+        arrays, rows = self.predictor.normalize_request(data)
+        if rows > self.max_batch:
+            raise MXNetError(
+                f"request of {rows} rows exceeds max_batch="
+                f"{self.max_batch}; split it client-side or call "
+                "Predictor.predict directly")
+        future = ServingFuture()
+        deadline = time.perf_counter() + deadline_ms / 1e3 \
+            if deadline_ms is not None else None
+        req = _Request(arrays, rows, future, deadline)
+        with self._cond:
+            if not self._running:
+                raise MXNetError(
+                    f"DynamicBatcher '{self.name}' is not started")
+            if self._queued_rows + rows > self.max_queue:
+                self._shed += 1
+                raise Overloaded(
+                    f"serving queue at bound ({self._queued_rows} rows "
+                    f"queued, max_queue={self.max_queue}); shedding "
+                    "load — retry with backoff")
+            self._queue.append(req)
+            self._queued_rows += rows
+            self._cond.notify_all()
+        return future
+
+    def predict(self, data, deadline_ms=None, timeout=None):
+        """Blocking convenience: ``submit(...).result(...)``."""
+        return self.submit(data, deadline_ms=deadline_ms).result(timeout)
+
+    # -- the batching loop ----------------------------------------------------
+    def _take_batch(self):
+        """Wait for work, coalesce up to max_batch rows (or until
+        max_wait_us after the first request), drop expired requests.
+        Returns a list of _Request or None at shutdown."""
+        max_wait_s = self.max_wait_us / 1e6
+        with self._cond:
+            while self._running and not self._queue:
+                self._cond.wait(timeout=0.1)
+            if not self._queue:
+                return None                     # shutdown, drained
+            # linger for company unless the batch is already full; a
+            # queued request's deadline CAPS the linger (minus a small
+            # slack for the wake-up jitter) — otherwise any deadline
+            # shorter than max_wait_us would expire while the batcher
+            # idles waiting for company that may never come. Deadlines
+            # bound QUEUE time: a request still live when its batch
+            # launches is served.
+            t_first = self._queue[0].t_submit
+            while self._running:
+                rows = 0
+                for r in self._queue:
+                    if rows + r.rows > self.max_batch:
+                        break
+                    rows += r.rows
+                launch_at = t_first + max_wait_s
+                for r in self._queue:
+                    if r.deadline is not None and \
+                            r.deadline - _DEADLINE_SLACK_S < launch_at:
+                        launch_at = r.deadline - _DEADLINE_SLACK_S
+                remaining = launch_at - time.perf_counter()
+                if rows >= self.max_batch or remaining <= 0:
+                    break
+                self._cond.wait(timeout=remaining)
+            batch, rows = [], 0
+            now = time.perf_counter()
+            while self._queue:
+                r = self._queue[0]
+                if r.deadline is not None and r.deadline < now:
+                    # expired while queued: fail it, don't spend chip
+                    # time on it, and let the next request take its slot
+                    self._queue.pop(0)
+                    self._queued_rows -= r.rows
+                    self._deadline_missed += 1
+                    r.future._complete(error=DeadlineExceeded(
+                        f"deadline expired after "
+                        f"{(now - r.t_submit) * 1e3:.1f} ms in queue"))
+                    continue
+                if rows + r.rows > self.max_batch:
+                    break
+                self._queue.pop(0)
+                self._queued_rows -= r.rows
+                batch.append(r)
+                rows += r.rows
+            return batch
+
+    def _loop(self):
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            if not batch:
+                continue                         # everything expired
+            rows = sum(r.rows for r in batch)
+            bucket = self.predictor.bucket_for(rows)
+            arrays = [
+                np.concatenate([r.arrays[i] for r in batch], axis=0)
+                if len(batch) > 1 else batch[0].arrays[i]
+                for i in range(len(self.predictor.data_names))]
+            try:
+                with self._tasks[bucket]:
+                    outs = self.predictor._run_bucket(arrays, rows,
+                                                      bucket)
+            except Exception as e:               # noqa: BLE001
+                # a failed program fails THIS batch's requests; the
+                # serving loop itself must survive
+                for r in batch:
+                    r.future._complete(error=e)
+                continue
+            now = time.perf_counter()
+            with self._lock:
+                self._occ_rows[bucket] += rows
+                self._occ_batches[bucket] += 1
+                self._served += len(batch)
+                lat = self._lat[bucket]
+                for r in batch:
+                    lat.append(now - r.t_submit)
+                del lat[:-_LAT_WINDOW]
+            start = 0
+            batched = self.predictor.out_batched
+            for r in batch:
+                # same return-shape contract as Predictor.predict:
+                # single-output models get the bare array, not [array]
+                mine = [o[start:start + r.rows] if is_b else o
+                        for o, is_b in zip(outs, batched)]
+                r.future._complete(
+                    result=mine[0] if len(mine) == 1 else mine)
+                start += r.rows
+
+    # -- observability --------------------------------------------------------
+    @property
+    def queue_depth(self):
+        """Currently queued rows (admission-control gauge)."""
+        with self._lock:
+            return self._queued_rows
+
+    def report(self, reset=False):
+        with self._lock:
+            per_bucket = {}
+            for b in self.predictor.buckets:
+                lat = self._lat[b]
+                nb = self._occ_batches[b]
+                per_bucket[b] = {
+                    "batches": nb,
+                    "rows": self._occ_rows[b],
+                    "occupancy": (self._occ_rows[b] / (nb * b))
+                    if nb else None,
+                    "p50_ms": float(np.percentile(lat, 50)) * 1e3
+                    if lat else None,
+                    "p99_ms": float(np.percentile(lat, 99)) * 1e3
+                    if lat else None,
+                }
+            out = {
+                "name": self.name,
+                "max_batch": self.max_batch,
+                "max_wait_us": self.max_wait_us,
+                "max_queue": self.max_queue,
+                "queue_depth": self._queued_rows,
+                "served_requests": self._served,
+                "shed_requests": self._shed,
+                "deadline_missed": self._deadline_missed,
+                "retraces": self.predictor.retraces,
+                "per_bucket": per_bucket,
+            }
+            if reset:
+                for b in self.predictor.buckets:
+                    self._lat[b] = []
+                    self._occ_rows[b] = 0
+                    self._occ_batches[b] = 0
+                self._shed = 0
+                self._deadline_missed = 0
+                self._served = 0
+        return out

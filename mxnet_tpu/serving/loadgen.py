@@ -1,0 +1,68 @@
+"""Closed-loop load generator for serving measurements.
+
+One implementation of the barrier-synchronized concurrent-client
+driver shared by ``bench.py`` (the ``resnet50_serving`` section),
+``tools/serving_bench.py`` (the frontier sweep), and the serving SLO
+test — the measurement methodology (barrier start, per-request latency
+under a lock, wall-clock window from barrier release to last join)
+must not fork across the three, or their ``batcher_efficiency``
+numbers stop being comparable.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["closed_loop", "raw_predict_rate"]
+
+
+def closed_loop(batcher, x_req, clients, per_client, timeout=300):
+    """Drive ``clients`` closed-loop threads, each submitting ``x_req``
+    (one request of ``x_req.shape[0]`` rows) ``per_client`` times
+    through ``batcher.predict``. Returns a dict with rows/s and
+    client-observed latency percentiles."""
+    rows = x_req.shape[0] if hasattr(x_req, "shape") else 1
+    lats = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(clients + 1)
+
+    def client():
+        barrier.wait()
+        mine = []
+        for _ in range(per_client):
+            t_r = time.perf_counter()
+            batcher.predict(x_req, timeout=timeout)
+            mine.append(time.perf_counter() - t_r)
+        with lock:
+            lats.extend(mine)
+
+    threads = [threading.Thread(target=client) for _ in range(clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    n_reqs = clients * per_client
+    return {
+        "rows_s": n_reqs * rows / dt,
+        "req_s": n_reqs / dt,
+        "p50_ms": float(np.percentile(lats, 50)) * 1e3,
+        "p99_ms": float(np.percentile(lats, 99)) * 1e3,
+        "wall_s": dt,
+    }
+
+
+def raw_predict_rate(predictor, x_full, steps=10, warm=2):
+    """Rows/s of the RAW compiled predict step on ``x_full`` (sized to
+    a bucket) — the ceiling ``batcher_efficiency`` is measured
+    against."""
+    for _ in range(warm):
+        predictor.predict(x_full)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        predictor.predict(x_full)
+    return x_full.shape[0] * steps / (time.perf_counter() - t0)

@@ -1,0 +1,354 @@
+"""Predictor: a frozen, bucketed, compiled inference program.
+
+The reference's C Predict API (c_predict_api.cc) freezes symbol+params
+and binds one executor per input shape; BucketingModule shares params
+across per-bucket executors. This class is both at once, TPU-native:
+ONE jitted inference function whose XLA cache is keyed by the padded
+batch bucket, parameters staged on device once (optionally cast to
+bf16), the ``MXTPU_PALLAS_FUSION`` graph rewrite applied to the predict
+program, and the request's (donated) input buffer the only per-call
+host↔device traffic.
+
+Bucketing: arbitrary request sizes pad up to the nearest configured
+bucket, so the set of compiled programs is small and fixed — a mixed
+stream of request sizes compiles each bucket exactly once
+(``retraces`` counts actual traces; tests pin it). Oversized inputs
+split into largest-bucket chunks.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .. import config
+from ..base import MXNetError
+from . import _register_predictor
+
+__all__ = ["Predictor", "default_buckets"]
+
+
+def default_buckets():
+    """Bucket set from MXTPU_SERVING_BUCKETS (ascending, deduped)."""
+    raw = str(config.get("MXTPU_SERVING_BUCKETS", "1,8,64"))
+    try:
+        buckets = sorted({int(x) for x in raw.replace(" ", "").split(",")
+                          if x})
+    except ValueError:
+        raise MXNetError(
+            f"MXTPU_SERVING_BUCKETS={raw!r} is not a comma-separated "
+            "integer list")
+    if not buckets or buckets[0] < 1:
+        raise MXNetError(
+            f"MXTPU_SERVING_BUCKETS={raw!r} must name positive batch "
+            "sizes")
+    return tuple(buckets)
+
+
+class Predictor:
+    """Inference-only compiled program over a frozen symbol+params.
+
+    Parameters
+    ----------
+    symbol : Symbol
+        The model graph (output heads as trained; SoftmaxOutput & co
+        evaluate in inference mode — no labels consumed).
+    arg_params / aux_params : dict name -> NDArray (or array)
+        Trained parameter/aux values; staged on device once.
+    data_names : tuple of str
+        Input argument names fed per request (everything else in
+        ``list_arguments`` must be in the params or is zero-filled —
+        e.g. a ``softmax_label`` head argument).
+    data_shapes : dict name -> per-row feature shape (no batch dim)
+        Required for every data name; buckets supply the batch dim.
+    buckets : tuple of int, optional
+        Ascending batch buckets (default: MXTPU_SERVING_BUCKETS).
+    compute_dtype : str/dtype, optional
+        e.g. "bfloat16": float32 params are cast ONCE at staging and
+        inputs in-program; outputs return float32.
+    apply_fusion : bool, optional
+        Force the MXTPU_PALLAS_FUSION predict-program rewrite on/off
+        (default: the flag's own resolution).
+    """
+
+    def __init__(self, symbol, arg_params, aux_params=None,
+                 data_names=("data",), data_shapes=None, buckets=None,
+                 compute_dtype=None, apply_fusion=None):
+        import jax
+        import jax.numpy as jnp
+
+        self.symbol = symbol
+        self.data_names = list(data_names)
+        self.buckets = tuple(sorted(set(buckets))) if buckets \
+            else default_buckets()
+        if data_shapes is None:
+            raise MXNetError(
+                "Predictor needs data_shapes={name: per-row feature "
+                "shape} — the batch dim comes from the buckets")
+        self.data_shapes = {n: tuple(s) for n, s in data_shapes.items()}
+        for n in self.data_names:
+            if n not in self.data_shapes:
+                raise MXNetError(f"data_shapes missing entry for '{n}'")
+        self._cdt = jnp.dtype(compute_dtype) \
+            if compute_dtype is not None else None
+
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        aux_params = aux_params or {}
+        self.param_names = [n for n in arg_names
+                            if n not in self.data_names]
+        self.output_names = symbol.list_outputs()
+
+        # infer the full argument/output shape sets at TWO batch sizes:
+        # comparing them identifies what actually TRACKS the batch —
+        # which non-param args are label-head inputs to zero-fill per
+        # bucket, and which outputs carry a batch axis to trim/split
+        # (a coincidental leading dim equal to the bucket must not
+        # count: a conv weight with num_filter == bucket is a missing
+        # PARAM, and a fixed-shape aux output must never be sliced).
+        # The largest-bucket shapes also feed the fusion pass's tile
+        # bail-outs (batch-independent, so one bucket suffices).
+        top = self.buckets[-1]
+
+        def _infer(b):
+            shape_kwargs = {n: (b,) + self.data_shapes[n]
+                            for n in self.data_names}
+            a, o, x = symbol.infer_shape(**shape_kwargs)
+            return (dict(zip(arg_names, a)), list(o),
+                    dict(zip(aux_names, x)))
+
+        arg_shape_map, out_shapes, aux_shape_map = _infer(top)
+        arg_alt, out_alt, _ = _infer(top + 1)
+
+        def _tracks_batch(s_top, s_alt, b_top=top):
+            return bool(s_top) and s_top[0] == b_top \
+                and s_alt[0] == b_top + 1
+
+        self.out_batched = [_tracks_batch(s, sa)
+                            for s, sa in zip(out_shapes, out_alt)]
+
+        # non-param, non-data args whose leading dim tracks the batch
+        # (e.g. a softmax_label head argument, unused in inference) are
+        # zero-filled per bucket; everything else must come from params
+        self._zero_args = []
+        missing = []
+        for n in self.param_names:
+            if n in arg_params:
+                continue
+            if _tracks_batch(arg_shape_map[n], arg_alt[n]):
+                self._zero_args.append(n)
+            else:
+                missing.append(n)
+        if missing:
+            raise MXNetError(f"Predictor missing parameters {missing}")
+        for n in aux_names:
+            if n not in aux_params:
+                raise MXNetError(f"Predictor missing aux state '{n}'")
+
+        def _stage(v, want_shape, name):
+            a = np.asarray(getattr(v, "_data", getattr(v, "data", v)))
+            if tuple(a.shape) != tuple(want_shape):
+                raise MXNetError(
+                    f"Predictor param '{name}' has shape {a.shape}, "
+                    f"inferred {tuple(want_shape)}")
+            x = jnp.asarray(a)
+            if self._cdt is not None and x.dtype == jnp.float32:
+                x = x.astype(self._cdt)
+            return jax.device_put(x)
+
+        self._pvals = {n: _stage(arg_params[n], arg_shape_map[n], n)
+                       for n in self.param_names
+                       if n not in self._zero_args}
+        self._avals = tuple(_stage(aux_params[n], aux_shape_map[n], n)
+                            for n in aux_names)
+
+        # predict-program fusion (symbol/fusion.py): same rewrite the
+        # train step gets, applied to the inference graph; tile
+        # bail-outs use the largest-bucket bound shapes
+        run_sym = symbol
+        self.fusion_report = None
+        from ..symbol.fusion import fusion_enabled, maybe_fuse
+        if apply_fusion if apply_fusion is not None else fusion_enabled():
+            shapes = dict(arg_shape_map)
+            shapes.update(aux_shape_map)
+            with config.override("MXTPU_PALLAS_FUSION", "1"):
+                fused_sym, self.fusion_report = maybe_fuse(
+                    symbol, {n: tuple(s) for n, s in shapes.items()},
+                    tag="predictor")
+            if fused_sym is not None:
+                run_sym = fused_sym
+
+        from ..executor import build_graph_fns
+        fwd, _, _ = build_graph_fns(run_sym)
+        self._arg_names = arg_names
+        key = jax.random.PRNGKey(0)
+        cdt = self._cdt
+        zero_args = set(self._zero_args)
+        pvals = self._pvals
+
+        def infer_fn(data_vals, avals):
+            # traced once per bucket shape: the Python body only runs
+            # at trace time, so this counter IS the retrace counter
+            self._retraces += 1
+            dmap = {}
+            for n, v in zip(self.data_names, data_vals):
+                if cdt is not None and v.dtype == jnp.float32:
+                    v = v.astype(cdt)
+                dmap[n] = v
+            bsz = data_vals[0].shape[0]
+
+            def val(n):
+                if n in dmap:
+                    return dmap[n]
+                if n in zero_args:
+                    s = (bsz,) + tuple(arg_shape_map[n][1:])
+                    return jnp.zeros(s, jnp.float32)
+                return pvals[n]
+
+            outs, _ = fwd(tuple(val(n) for n in arg_names), avals, key,
+                          False)
+            return tuple(o.astype(jnp.float32)
+                         if cdt is not None and o.dtype == cdt else o
+                         for o in outs)
+
+        # donate the request buffers: they are fresh padded arrays each
+        # call, so XLA may reuse them for outputs (the CPU backend
+        # cannot donate and warns per compile, so proxy runs skip it)
+        donate = {} if jax.default_backend() == "cpu" \
+            else {"donate_argnums": (0,)}
+        self._jit = jax.jit(infer_fn, **donate)
+        self._retraces = 0
+        self._lock = threading.Lock()
+        # per-bucket counters: calls, rows served, pad rows wasted
+        self._bucket_calls = {b: 0 for b in self.buckets}
+        self._bucket_rows = {b: 0 for b in self.buckets}
+        self._bucket_pad_rows = {b: 0 for b in self.buckets}
+        _register_predictor(self)
+
+    # -- construction helpers -------------------------------------------------
+    @classmethod
+    def from_module(cls, module, **kwargs):
+        """Freeze a trained (bound+initialized) Module. Data feature
+        shapes come from the module's bound data_shapes; params are
+        synced from device."""
+        arg_params, aux_params = module.get_params()
+        kwargs.setdefault("data_names", list(module.data_names))
+        kwargs.setdefault("data_shapes", {
+            n: tuple(s[1:]) for n, s in module.data_shapes})
+        return cls(module.symbol, arg_params, aux_params, **kwargs)
+
+    # -- bucketing ------------------------------------------------------------
+    @property
+    def max_batch(self):
+        return self.buckets[-1]
+
+    def bucket_for(self, n):
+        """Smallest bucket >= n, or the largest bucket (callers chunk)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    @property
+    def retraces(self):
+        """Number of XLA traces taken — at most one per bucket after
+        warmup; tests pin this."""
+        return self._retraces
+
+    # -- execution ------------------------------------------------------------
+    def _run_bucket(self, arrays, rows, bucket):
+        """Pad name-ordered request arrays to ``bucket`` rows and run
+        the compiled program. Returns trimmed numpy outputs."""
+        import jax.numpy as jnp
+        padded = []
+        for a in arrays:
+            if rows != bucket:
+                pad = np.zeros((bucket - rows,) + a.shape[1:], a.dtype)
+                a = np.concatenate([a, pad], axis=0)
+            padded.append(jnp.asarray(a))
+        with self._lock:
+            outs = self._jit(tuple(padded), self._avals)
+            self._bucket_calls[bucket] += 1
+            self._bucket_rows[bucket] += rows
+            self._bucket_pad_rows[bucket] += bucket - rows
+        return [np.asarray(o)[:rows] if batched else np.asarray(o)
+                for o, batched in zip(outs, self.out_batched)]
+
+    def normalize_request(self, data):
+        """Validate one request and return ``(arrays, rows)``: numpy
+        arrays ordered by ``data_names``. The single input-contract
+        check shared by ``predict`` and ``DynamicBatcher.submit`` —
+        the two serving surfaces must reject identically."""
+        if not isinstance(data, dict):
+            data = {self.data_names[0]: data}
+        arrays = []
+        for n in self.data_names:
+            if n not in data:
+                raise MXNetError(f"request missing data input '{n}'")
+            a = np.asarray(getattr(data[n], "_data", data[n]))
+            if tuple(a.shape[1:]) != self.data_shapes[n]:
+                raise MXNetError(
+                    f"request input '{n}' rows have shape "
+                    f"{tuple(a.shape[1:])}, expected "
+                    f"{self.data_shapes[n]}")
+            arrays.append(a)
+        n_rows = arrays[0].shape[0]
+        if n_rows < 1:
+            raise MXNetError("got an empty (0-row) request")
+        if any(a.shape[0] != n_rows for a in arrays):
+            raise MXNetError("request inputs disagree on batch size")
+        return arrays, n_rows
+
+    def predict(self, data):
+        """Run inference on one request. ``data``: array (single data
+        input) or dict name -> array, any leading batch size; oversized
+        requests chunk through the largest bucket. Returns one numpy
+        array (single output) or a list — same shape contract as
+        ``DynamicBatcher.predict``."""
+        arrays, n_rows = self.normalize_request(data)
+        chunks = []
+        start = 0
+        while start < n_rows:
+            rows = min(n_rows - start, self.max_batch)
+            bucket = self.bucket_for(rows)
+            chunks.append(self._run_bucket(
+                [a[start:start + rows] for a in arrays], rows, bucket))
+            start += rows
+        if len(chunks) == 1:
+            outs = chunks[0]
+        else:
+            outs = [np.concatenate([c[i] for c in chunks], axis=0)
+                    if batched else chunks[0][i]
+                    for i, batched in enumerate(self.out_batched)]
+        return outs[0] if len(outs) == 1 else outs
+
+    def warmup(self):
+        """Compile every bucket up front (serving must not pay a trace
+        on a live request). Returns the retrace count."""
+        for b in self.buckets:
+            arrays = [np.zeros((b,) + self.data_shapes[n], np.float32)
+                      for n in self.data_names]
+            self._run_bucket(arrays, b, b)
+        return self._retraces
+
+    # -- observability --------------------------------------------------------
+    def report(self, reset=False):
+        with self._lock:
+            out = {
+                "buckets": list(self.buckets),
+                "retraces": self._retraces,
+                "per_bucket": {
+                    b: {"calls": self._bucket_calls[b],
+                        "rows": self._bucket_rows[b],
+                        "pad_rows": self._bucket_pad_rows[b]}
+                    for b in self.buckets},
+                "fused_sites": len(self.fusion_report["sites"])
+                if self.fusion_report else 0,
+                "compute_dtype": str(self._cdt) if self._cdt else None,
+            }
+            if reset:
+                for b in self.buckets:
+                    self._bucket_calls[b] = 0
+                    self._bucket_rows[b] = 0
+                    self._bucket_pad_rows[b] = 0
+        return out

@@ -78,10 +78,19 @@ def fusion_enabled() -> bool:
 def fusion_report(reset: bool = False) -> dict:
     """What the fusion pass rewrote in this process: per-rewrite site
     lists (conv/bn/activation node names + matmul geometry and tiles)
-    and per-site bail-out reasons. One entry per executor build."""
+    and per-site bail-out reasons. One entry per executor build;
+    ``by_tag`` splits the site counts by which program was rewritten
+    (``executor`` = train/grad builds, ``executor_infer`` = inference-
+    only executor binds, ``fused_step`` = the whole-step train program,
+    ``predictor`` = serving predict programs)."""
+    by_tag: Dict[str, int] = {}
+    for r in _REPORTS:
+        by_tag[r.get("tag", "?")] = \
+            by_tag.get(r.get("tag", "?"), 0) + len(r["sites"])
     out = {
         "num_rewritten_sites": sum(len(r["sites"]) for r in _REPORTS),
         "num_bailouts": sum(len(r["bailouts"]) for r in _REPORTS),
+        "by_tag": by_tag,
         "rewrites": list(_REPORTS),
     }
     if reset:

@@ -1,0 +1,140 @@
+"""Regression tests for ``BaseModule.predict`` pad handling
+(module/base_module.py:137-170, reference base_module.py:310).
+
+``NDArrayIter(last_batch_handle='pad')`` wraps the final partial batch
+around to the start and records ``batch.pad``; predict must trim those
+pad rows EXACTLY once — off-by-one trimming silently corrupts the tail
+of every merged prediction, and double-trimming under
+``merge_batches=False`` once regressed in the reference. Pinned here:
+
+- last partial batch with ``merge_batches=True``: merged output has
+  exactly num_samples rows and the tail rows match the unpadded
+  forward;
+- ``merge_batches=False``: per-batch outputs keep pad rows trimmed
+  per batch (and only once);
+- multi-output heads (Group symbol): every output trimmed
+  consistently, ``always_output_list`` honored;
+- ``iter_predict`` agrees with predict on the same iterator.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+BATCH = 4
+N = 10          # 10 % 4 != 0 -> last batch has pad = 2
+FEAT = 6
+
+
+def _mlp_module(num_out=3, multi_head=False):
+    mx.random.seed(0)
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc, act_type="tanh", name="act1")
+    head = mx.sym.FullyConnected(act, num_hidden=num_out, name="fc2")
+    if multi_head:
+        sym = mx.sym.Group([mx.sym.SoftmaxOutput(head, name="softmax"),
+                            mx.sym.sigmoid(act, name="gate")])
+    else:
+        sym = mx.sym.SoftmaxOutput(head, name="softmax")
+    mod = mx.mod.Module(context=mx.cpu(), symbol=sym,
+                        label_names=("softmax_label",)
+                        if not multi_head else ("softmax_label",))
+    mod.bind(data_shapes=[("data", (BATCH, FEAT))],
+             label_shapes=[("softmax_label", (BATCH,))],
+             for_training=False)
+    mod.init_params(mx.init.Xavier())
+    return mod
+
+
+def _data():
+    rng = np.random.RandomState(7)
+    x = rng.rand(N, FEAT).astype(np.float32)
+    y = rng.randint(0, 3, (N,)).astype(np.float32)
+    return x, y
+
+
+def _reference_outputs(mod, x, n_outs=1):
+    """Ground truth: forward each sample padded into its own batch —
+    no shared pad bookkeeping to get wrong."""
+    outs = [[] for _ in range(n_outs)]
+    for i in range(x.shape[0]):
+        xp = np.concatenate([x[i:i + 1]] * BATCH)
+        mod.forward(mx.io.DataBatch([mx.nd.array(xp)], None),
+                    is_train=False)
+        for j, o in enumerate(mod.get_outputs()):
+            outs[j].append(o.asnumpy()[0])
+    return [np.stack(o) for o in outs]
+
+
+def test_partial_last_batch_merged_trims_pad_exactly_once():
+    mod = _mlp_module()
+    x, y = _data()
+    it = mx.io.NDArrayIter(x, y, batch_size=BATCH,
+                           last_batch_handle="pad")
+    out = mod.predict(it)
+    # exactly N rows survive: 3 batches of 4 = 12 forwarded rows, the
+    # 2 wrap-around pad rows trimmed once (not 0, not 4)
+    assert out.shape == (N, 3)
+    ref = _reference_outputs(mod, x)[0]
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_partial_last_batch_unmerged_trims_per_batch():
+    mod = _mlp_module()
+    x, y = _data()
+    it = mx.io.NDArrayIter(x, y, batch_size=BATCH,
+                           last_batch_handle="pad")
+    out_list = mod.predict(it, merge_batches=False)
+    assert len(out_list) == 3
+    assert [o[0].shape[0] for o in out_list] == [4, 4, 2], \
+        "pad rows must be trimmed from the LAST batch only, once"
+    ref = _reference_outputs(mod, x)[0]
+    got = np.concatenate([o[0].asnumpy() for o in out_list])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_multi_output_head_trims_every_output():
+    mod = _mlp_module(multi_head=True)
+    x, y = _data()
+    it = mx.io.NDArrayIter(x, y, batch_size=BATCH,
+                           last_batch_handle="pad")
+    outs = mod.predict(it)
+    assert isinstance(outs, list) and len(outs) == 2
+    assert outs[0].shape == (N, 3)      # softmax head
+    assert outs[1].shape == (N, 8)      # gate head
+    refs = _reference_outputs(mod, x, n_outs=2)
+    for got, ref in zip(outs, refs):
+        np.testing.assert_allclose(got.asnumpy(), ref, rtol=1e-5,
+                                   atol=1e-5)
+    # unmerged: each batch keeps both heads, pad trimmed from both
+    it.reset()
+    out_list = mod.predict(it, merge_batches=False, reset=False)
+    assert [len(o) for o in out_list] == [2, 2, 2]
+    assert out_list[-1][0].shape[0] == 2
+    assert out_list[-1][1].shape[0] == 2
+
+
+def test_always_output_list_single_head():
+    mod = _mlp_module()
+    x, y = _data()
+    it = mx.io.NDArrayIter(x, y, batch_size=BATCH,
+                           last_batch_handle="pad")
+    out = mod.predict(it, always_output_list=True)
+    assert isinstance(out, list) and len(out) == 1
+    assert out[0].shape == (N, 3)
+
+
+def test_iter_predict_agrees_with_predict():
+    mod = _mlp_module()
+    x, y = _data()
+    it = mx.io.NDArrayIter(x, y, batch_size=BATCH,
+                           last_batch_handle="pad")
+    merged = mod.predict(it).asnumpy()
+    it.reset()
+    rows = []
+    for outputs, nbatch, batch in mod.iter_predict(it, reset=False):
+        rows.append(outputs[0].asnumpy())
+        # the yielded outputs are already trimmed by batch.pad
+        assert outputs[0].shape[0] == BATCH - batch.pad
+    np.testing.assert_allclose(np.concatenate(rows), merged,
+                               rtol=1e-6, atol=1e-6)

@@ -80,6 +80,11 @@ def test_lstm_bucketing_example_converges():
     prev_argv = sys.argv
     root.addHandler(cap)
     root.setLevel(logging.INFO)
+    # the example draws its init and shuffle from the AMBIENT RNGs (it
+    # never seeds) — pin them, or this convergence bound wobbles with
+    # whatever tests happened to run earlier in the session
+    mx.random.seed(0)
+    np.random.seed(0)
     try:
         sys.argv = ["lstm_bucketing.py", "--num-epochs", "2",
                     "--batch-size", "16", "--num-hidden", "64",

@@ -194,6 +194,46 @@ def test_fusion_report_hook():
     rep = mx.fusion_report()
     assert rep["num_rewritten_sites"] >= 1
     assert rep["rewrites"][-1]["tag"] == "executor"
+    assert rep["by_tag"]["executor"] >= 1
+
+
+def test_predict_program_rewrites_in_eval_mode():
+    """The inference path gets the rewrite too: an inference-only bind
+    (grad_req all null) routes through the pass under its own
+    fusion_report tag, and the fused predict program matches the
+    unfused one in EVAL mode — i.e. through the moving-stats branch of
+    the fused op, which the train-step tests never touch."""
+    sym = _block_sym()
+    shape = (2, 8, 4, 4)
+    rng = np.random.RandomState(3)
+    x = rng.randn(*shape).astype(np.float32)
+    mmean = rng.rand(8).astype(np.float32)
+    mvar = rng.rand(8).astype(np.float32) + 0.5
+
+    def run_predict(flag):
+        with _flag(flag):
+            mx.fusion_report(reset=True)
+            mx.random.seed(0)
+            np.random.seed(0)
+            mod = mx.mod.Module(context=mx.cpu(), symbol=sym,
+                                label_names=())
+            mod.bind(data_shapes=[("data", shape)], for_training=False)
+            mod.init_params(mx.init.Xavier())
+            # distinctive moving stats so the eval path is actually
+            # exercised (zeros/ones would alias the batch-stat branch)
+            mod._exec.aux_dict["f_bn_moving_mean"][:] = mmean
+            mod._exec.aux_dict["f_bn_moving_var"][:] = mvar
+            mod.forward(mx.io.DataBatch([mx.nd.array(x)], None),
+                        is_train=False)
+            out = mod.get_outputs()[0].asnumpy().copy()
+            return out, mx.fusion_report()
+
+    o1, rep1 = run_predict("1")
+    o0, rep0 = run_predict("0")
+    assert rep1["by_tag"].get("executor_infer", 0) == 1, \
+        "inference-only executor build must report under its own tag"
+    assert rep0["num_rewritten_sites"] == 0
+    np.testing.assert_allclose(o1, o0, rtol=2e-5, atol=2e-5)
 
 
 def test_fused_step_bytes_accessed_below_unfused():

@@ -21,7 +21,7 @@ _ROOT = os.path.dirname(_TESTS)
 
 _KNOWN = {
     # registered project markers
-    "slow",
+    "slow", "serving",
     # pytest built-ins
     "parametrize", "skip", "skipif", "xfail", "usefixtures",
     "filterwarnings",
@@ -66,3 +66,41 @@ def test_pallas_interpret_suites_run_in_tier1():
     assert not marked, (
         f"{marked} must not be marked slow: their interpret-mode cases "
         "are tier-1's only coverage of the Pallas fusion code path")
+
+
+def test_serving_markers_are_registered_and_used():
+    """The serving suite is latency-sensitive in places, so its marker
+    hygiene matters twice over: the ``serving`` marker must be
+    registered (so ``-m serving`` selects the subsystem), and every
+    serving test module must actually carry it."""
+    ini = os.path.join(_ROOT, "pytest.ini")
+    cp = configparser.ConfigParser()
+    cp.read(ini)
+    markers = cp.get("pytest", "markers", fallback="")
+    assert re.search(r"^\s*serving\s*:", markers, re.M), \
+        "the 'serving' marker must be registered in pytest.ini"
+    serving_files = {n for n in os.listdir(_TESTS)
+                     if n.startswith("test_serving")}
+    assert serving_files, "serving test suite missing"
+    uses = _mark_uses().get("serving", set())
+    unmarked = serving_files - uses
+    assert not unmarked, (
+        f"{unmarked} must carry pytest.mark.serving so '-m serving' "
+        "selects the whole subsystem")
+
+
+def test_serving_fast_paths_stay_in_tier1():
+    """Timing-SLO serving cases (throughput-efficiency pins) are
+    ``slow``; everything functional — retrace pinning, shedding,
+    deadlines, correctness — must stay tier-1. Pin that the fast
+    serving suite keeps a module-level tier-1 presence: a file-wide
+    ``pytestmark`` slow mark on test_serving.py would silently drop the
+    subsystem from the gate."""
+    path = os.path.join(_TESTS, "test_serving.py")
+    assert os.path.exists(path), "tests/test_serving.py missing"
+    with open(path) as f:
+        src = f.read()
+    m = re.search(r"^pytestmark\s*=.*$", src, re.M)
+    assert m and "slow" not in m.group(0), (
+        "test_serving.py's module-level pytestmark must not include "
+        "slow — the functional serving cases are tier-1 coverage")

@@ -1,0 +1,379 @@
+"""The inference serving subsystem (mxnet_tpu/serving/):
+
+- Predictor: bucketed compile cache — mixed-size request streams
+  compile each bucket exactly once (retrace counter pinned), outputs
+  match the Module predict path, oversize requests chunk;
+- predict-program fusion: the MXTPU_PALLAS_FUSION rewrite applies to
+  the inference program (tag='predictor') and is numerically
+  equivalent in eval mode (moving-stats path);
+- bf16 compute option returns float32 outputs close to the f32 path;
+- DynamicBatcher: coalescing with per-request result splitting,
+  multi-client correctness, queue-bound load shedding (Overloaded, not
+  a hang), per-request deadlines (DeadlineExceeded), stop/drain;
+- observability: serving_report() per-bucket counters, occupancy,
+  latency percentiles, shed/deadline counters; profiler aggregate rows
+  under the serving domain.
+
+Timing-SLO cases (throughput efficiency vs the raw predict step) are
+in test_serving_slo.py, marked slow.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving
+
+pytestmark = pytest.mark.serving
+
+
+def _net(num_filter=16, num_hidden=10, name="f"):
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name=f"{name}_bn", fix_gamma=False,
+                          eps=1e-3, momentum=0.9)
+    act = mx.sym.Activation(bn, act_type="relu", name=f"{name}_relu")
+    conv = mx.sym.Convolution(act, kernel=(1, 1), num_filter=num_filter,
+                              no_bias=True, name=f"{name}_conv")
+    fc = mx.sym.FullyConnected(mx.sym.Flatten(conv),
+                               num_hidden=num_hidden, name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+FEAT = (8, 4, 4)
+
+
+def _trained_module(seed=0):
+    mx.random.seed(seed)
+    net = _net()
+    mod = mx.mod.Module(context=mx.cpu(), symbol=net)
+    mod.bind(data_shapes=[("data", (8,) + FEAT)],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+    return mod
+
+
+def _predictor(mod=None, buckets=(2, 8, 16), fusion="0", **kw):
+    mod = mod or _trained_module()
+    with mx.config.override("MXTPU_PALLAS_FUSION", fusion):
+        return mod.as_predictor(buckets=buckets, **kw), mod
+
+
+def _module_ref(mod, x):
+    """Reference outputs through the Module predict path (padded to the
+    bound batch size of 8)."""
+    n = x.shape[0]
+    pad = (-n) % 8
+    xp = np.concatenate([x, np.zeros((pad,) + FEAT, np.float32)]) \
+        if pad else x
+    outs = []
+    for i in range(0, xp.shape[0], 8):
+        mod.forward(mx.io.DataBatch([mx.nd.array(xp[i:i + 8])], None),
+                    is_train=False)
+        outs.append(mod.get_outputs()[0].asnumpy().copy())
+    return np.concatenate(outs)[:n]
+
+
+# ---------------------------------------------------------------------------
+# Predictor
+# ---------------------------------------------------------------------------
+def test_bucketed_cache_compiles_each_bucket_exactly_once():
+    """Mixed request sizes (1..16 rows, shuffled) land on 3 buckets ->
+    exactly 3 traces, all during warmup; serving retraces ZERO."""
+    pred, mod = _predictor()
+    assert pred.warmup() == 3
+    pred.report(reset=True)      # drop the 3 warmup calls
+    rng = np.random.RandomState(0)
+    sizes = list(rng.randint(1, 17, size=30))
+    for n in sizes:
+        out = pred.predict(rng.rand(n, *FEAT).astype(np.float32))
+        assert out.shape == (n, 10)
+    assert pred.retraces == 3, \
+        "a served request retraced — the bucket padding leaked a shape"
+    rep = pred.report()
+    assert sum(v["calls"] for v in rep["per_bucket"].values()) == 30
+
+
+def test_predictor_matches_module_predict():
+    pred, mod = _predictor()
+    rng = np.random.RandomState(1)
+    for n in (1, 2, 7, 16):
+        x = rng.rand(n, *FEAT).astype(np.float32)
+        np.testing.assert_allclose(
+            pred.predict(x), _module_ref(mod, x),
+            rtol=2e-5, atol=2e-5, err_msg=f"n={n}")
+
+
+def test_predictor_chunks_oversize_requests():
+    pred, mod = _predictor()
+    rng = np.random.RandomState(2)
+    x = rng.rand(40, *FEAT).astype(np.float32)  # > largest bucket (16)
+    np.testing.assert_allclose(pred.predict(x), _module_ref(mod, x),
+                               rtol=2e-5, atol=2e-5)
+    assert pred.retraces <= 3
+
+
+def test_predict_program_fusion_applies_and_matches():
+    """The MXTPU_PALLAS_FUSION rewrite reaches the serving predict
+    program: sites reported under tag='predictor', inference-mode
+    (moving-stats) numerics match the unfused program."""
+    mod = _trained_module()
+    mx.fusion_report(reset=True)
+    pred1, _ = _predictor(mod=mod, fusion="1")
+    pred0, _ = _predictor(mod=mod, fusion="0")
+    assert pred1.fusion_report is not None
+    assert len(pred1.fusion_report["sites"]) == 1
+    assert pred0.fusion_report is None
+    rep = mx.fusion_report()
+    assert rep["by_tag"].get("predictor", 0) >= 1
+    rng = np.random.RandomState(3)
+    x = rng.rand(5, *FEAT).astype(np.float32)
+    np.testing.assert_allclose(pred1.predict(x), pred0.predict(x),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_infer_only_executor_reports_own_fusion_tag():
+    """An inference-only Module bind (for_training=False -> grad_req
+    all null) routes through the fusion pass under tag='executor_infer'
+    — fusion_report() distinguishes predict programs from train
+    builds."""
+    mx.fusion_report(reset=True)
+    with mx.config.override("MXTPU_PALLAS_FUSION", "1"):
+        mod = mx.mod.Module(context=mx.cpu(), symbol=_net())
+        mod.bind(data_shapes=[("data", (4,) + FEAT)], for_training=False)
+        mod.init_params(mx.init.Xavier())
+        mod.forward(mx.io.DataBatch(
+            [mx.nd.array(np.zeros((4,) + FEAT, np.float32))], None),
+            is_train=False)
+    rep = mx.fusion_report()
+    assert rep["by_tag"].get("executor_infer", 0) == 1
+    assert "executor" not in rep["by_tag"] or \
+        rep["by_tag"]["executor"] == 0
+
+
+def test_bf16_compute_option():
+    mod = _trained_module()
+    pred16, _ = _predictor(mod=mod, compute_dtype="bfloat16")
+    pred32, _ = _predictor(mod=mod)
+    x = np.random.RandomState(4).rand(4, *FEAT).astype(np.float32)
+    o16 = pred16.predict(x)
+    assert o16.dtype == np.float32
+    np.testing.assert_allclose(o16, pred32.predict(x), rtol=0.05,
+                               atol=0.05)
+    assert pred16.report()["compute_dtype"] == "bfloat16"
+
+
+def test_predictor_input_validation():
+    pred, _ = _predictor()
+    with pytest.raises(mx.MXNetError):
+        pred.predict(np.zeros((2, 3, 4, 4), np.float32))  # wrong feat
+    with pytest.raises(mx.MXNetError):
+        pred.predict({"wrong_name": np.zeros((2,) + FEAT, np.float32)})
+    with pytest.raises(mx.MXNetError):
+        pred.predict(np.zeros((0,) + FEAT, np.float32))   # empty
+
+
+def test_missing_param_raises_even_when_dim_matches_bucket():
+    """A genuinely missing parameter must raise at construction — even
+    one whose leading dim happens to EQUAL the largest bucket (e.g. a
+    conv weight with num_filter == 16 and buckets ending at 16), which
+    a naive 'leading dim == batch' label-arg heuristic would silently
+    zero-fill into garbage predictions."""
+    mod = _trained_module()
+    arg_params, aux_params = mod.get_params()
+    broken = {k: v for k, v in arg_params.items()
+              if k != "f_conv_weight"}          # shape (16, 8, 1, 1)
+    with pytest.raises(mx.MXNetError, match="f_conv_weight"):
+        serving.Predictor(mod.symbol, broken, aux_params,
+                          data_shapes={"data": FEAT},
+                          buckets=(2, 8, 16))
+    # the label-head argument IS still zero-filled, not 'missing'
+    pred = serving.Predictor(mod.symbol, arg_params, aux_params,
+                             data_shapes={"data": FEAT},
+                             buckets=(2, 8, 16))
+    assert pred._zero_args == ["softmax_label"]
+
+
+# ---------------------------------------------------------------------------
+# DynamicBatcher
+# ---------------------------------------------------------------------------
+def test_batcher_coalesces_and_splits_correctly():
+    """64 concurrent single/odd-size requests through the batcher come
+    back per-request, matching the Module predict path, with zero
+    retraces past warmup."""
+    pred, mod = _predictor()
+    rng = np.random.RandomState(5)
+    reqs = [rng.rand(rng.randint(1, 5), *FEAT).astype(np.float32)
+            for _ in range(64)]
+    with serving.DynamicBatcher(pred, max_wait_us=2000,
+                                max_queue=10_000, name="coalesce") as b:
+        futs = [b.submit(x) for x in reqs]
+        outs = [f.result(timeout=60) for f in futs]
+    for x, o in zip(reqs, outs):
+        np.testing.assert_allclose(o, _module_ref(mod, x),
+                                   rtol=2e-5, atol=2e-5)
+    assert pred.retraces == 3
+    rep = b.report()
+    assert rep["served_requests"] == 64
+    # coalescing happened: fewer device batches than requests
+    total_batches = sum(v["batches"]
+                       for v in rep["per_bucket"].values())
+    assert total_batches < 64
+
+
+def test_batcher_multithreaded_clients():
+    pred, mod = _predictor()
+    with serving.DynamicBatcher(pred, max_wait_us=1000,
+                                max_queue=10_000, name="mt") as b:
+        results = {}
+        errs = []
+
+        def client(i):
+            rng = np.random.RandomState(100 + i)
+            try:
+                for j in range(5):
+                    x = rng.rand(2, *FEAT).astype(np.float32)
+                    out = b.predict(x, timeout=60)
+                    results[(i, j)] = (x, out)
+            except Exception as e:            # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errs
+    assert len(results) == 40
+    for x, o in results.values():
+        np.testing.assert_allclose(o, _module_ref(mod, x),
+                                   rtol=2e-5, atol=2e-5)
+    assert pred.retraces == 3
+
+
+def test_overload_sheds_instead_of_hanging():
+    """Past the queue bound, submit() raises Overloaded IMMEDIATELY —
+    bounded time, no queueing. The shed counter records it."""
+    pred, _ = _predictor()
+    b = serving.DynamicBatcher(pred, max_wait_us=200_000, max_queue=4,
+                               name="shed")
+    b.start()
+    try:
+        held = [b.submit(np.zeros((2,) + FEAT, np.float32))
+                for _ in range(2)]           # fills the 4-row bound
+        t0 = time.perf_counter()
+        with pytest.raises(serving.Overloaded):
+            b.submit(np.zeros((2,) + FEAT, np.float32))
+        assert time.perf_counter() - t0 < 1.0, \
+            "shedding must be immediate, not a timeout"
+        assert b.report()["shed_requests"] == 1
+        for f in held:
+            f.result(timeout=60)
+    finally:
+        b.stop()
+
+
+def test_deadline_expired_in_queue():
+    """A request whose deadline passes while queued completes with
+    DeadlineExceeded and never occupies a batch slot."""
+    pred, _ = _predictor()
+    b = serving.DynamicBatcher(pred, max_wait_us=300_000,
+                               max_queue=10_000, name="deadline")
+    b.start()
+    try:
+        # deadline_ms=0: already expired by the time the worker can
+        # collect it — must fail, not serve
+        doomed = b.submit(np.zeros((1,) + FEAT, np.float32),
+                          deadline_ms=0)
+        time.sleep(0.05)
+        ok = b.submit(np.zeros((1,) + FEAT, np.float32))
+        with pytest.raises(serving.DeadlineExceeded):
+            doomed.result(timeout=60)
+        ok.result(timeout=60)
+        assert b.report()["deadline_missed"] == 1
+    finally:
+        b.stop()
+
+
+def test_sub_window_deadline_served_early_when_idle():
+    """A live deadline SHORTER than the coalescing window must cap the
+    linger, not expire in it: on an idle server the request launches
+    early and is SERVED — deadlines bound queue time, they are not a
+    config trap against max_wait_us."""
+    pred, _ = _predictor()
+    b = serving.DynamicBatcher(pred, max_wait_us=500_000,
+                               max_queue=10_000, name="earlylaunch")
+    b.start()
+    try:
+        t0 = time.perf_counter()
+        out = b.predict(np.zeros((1,) + FEAT, np.float32),
+                        deadline_ms=100, timeout=60)
+        dt = time.perf_counter() - t0
+        assert out.shape == (1, 10)
+        assert dt < 1.0, (
+            f"request took {dt:.2f}s — the 0.5s linger window was not "
+            "capped by the 100ms deadline")
+        assert b.report()["deadline_missed"] == 0
+    finally:
+        b.stop()
+
+
+def test_batcher_rejects_oversize_and_unstarted():
+    pred, _ = _predictor()
+    b = serving.DynamicBatcher(pred, name="guards")
+    with pytest.raises(mx.MXNetError):
+        b.submit(np.zeros((1,) + FEAT, np.float32))  # not started
+    b.start()
+    try:
+        with pytest.raises(mx.MXNetError):
+            b.submit(np.zeros((17,) + FEAT, np.float32))  # > max_batch
+    finally:
+        b.stop()
+
+
+def test_stop_drain_serves_queued_requests():
+    pred, _ = _predictor()
+    b = serving.DynamicBatcher(pred, max_wait_us=50_000,
+                               max_queue=10_000, name="drain")
+    b.start()
+    futs = [b.submit(np.zeros((1,) + FEAT, np.float32))
+            for _ in range(4)]
+    b.stop(drain=True)
+    for f in futs:
+        assert f.result(timeout=1) is not None
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+def test_serving_report_and_profiler_rows():
+    pred, _ = _predictor()
+    with serving.DynamicBatcher(pred, max_wait_us=500,
+                                max_queue=10_000, name="obs") as b:
+        futs = [b.submit(np.zeros((2,) + FEAT, np.float32))
+                for _ in range(6)]
+        for f in futs:
+            f.result(timeout=60)
+        rep = serving.serving_report()
+    mine = [r for r in rep["batchers"] if r["name"] == "obs"]
+    assert len(mine) == 1
+    r = mine[0]
+    assert r["served_requests"] == 6
+    assert r["queue_depth"] == 0
+    served = [v for v in r["per_bucket"].values() if v["batches"]]
+    assert served, "no per-bucket stats recorded"
+    for v in served:
+        assert 0.0 < v["occupancy"] <= 1.0
+        assert v["p50_ms"] is not None and v["p99_ms"] >= v["p50_ms"]
+    assert any(p["retraces"] == 3 for p in rep["predictors"])
+    # the same micro-batches feed the profiler aggregate table under
+    # the serving domain
+    table = mx.profiler.dumps()
+    assert "serving::obs::bucket" in table
+    # reset clears the windows
+    b2 = serving.serving_report(reset=True)
+    rep2 = serving.serving_report()
+    mine2 = [r for r in rep2["batchers"] if r["name"] == "obs"][0]
+    assert mine2["served_requests"] == 0

@@ -1,0 +1,119 @@
+"""Serving frontier sweep: bucket sets × coalescing windows.
+
+Usage: python tools/serving_bench.py "1,8,64:2000" "1,16,128:500" ...
+Each spec is ``buckets:max_wait_us[:clients]`` — a comma-separated
+bucket set, the DynamicBatcher coalescing window in µs, and optionally
+the concurrent-client count (default 64). For each spec the sweep
+drives single-image closed-loop clients through the batcher over a
+frozen ResNet-50 Predictor and prints one frontier row: p50/p99
+request latency, img/s, batch occupancy at the hot bucket, and the
+efficiency vs the RAW compiled predict step at the largest bucket —
+the table that picks the bucket set / wait window trade-off for a
+latency SLO (mirrors tools/perf_sweep.py conventions; serving
+internals: mxnet_tpu/serving/).
+
+Off-TPU this runs the same code path compiled for CPU — slower, same
+frontier shape. MXTPU_SERVING_* env vars set the defaults the sweep
+overrides per spec.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", "examples",
+    "image_classification"))
+
+
+def build_predictor(buckets, batch=64, small=False):
+    import mxnet_tpu as mx
+    if small:
+        # CPU-proxy model (the --small flag): same serving machinery,
+        # a step cheap enough to sweep interactively
+        data = mx.sym.Variable("data")
+        bn = mx.sym.BatchNorm(data, name="bn", fix_gamma=False)
+        act = mx.sym.Activation(bn, act_type="relu", name="relu")
+        conv = mx.sym.Convolution(act, kernel=(3, 3), pad=(1, 1),
+                                  num_filter=32, no_bias=True,
+                                  name="conv")
+        fc = mx.sym.FullyConnected(mx.sym.Flatten(conv), num_hidden=64,
+                                   name="fc")
+        net = mx.sym.SoftmaxOutput(fc, name="softmax")
+        feat = (16, 16, 16)
+    else:
+        from symbols import resnet as resnet_sym
+        net = resnet_sym.get_symbol(1000, 50, "3,224,224", stem="s2d")
+        feat = (3, 224, 224)
+    mx.random.seed(0)
+    mod = mx.mod.Module(context=mx.gpu(0), symbol=net)
+    mod.bind(data_shapes=[("data", (batch,) + feat)],
+             label_shapes=[("softmax_label", (batch,))],
+             for_training=False)
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian",
+                                   factor_type="in", magnitude=2))
+    return mod.as_predictor(
+        buckets=buckets,
+        compute_dtype=None if small else "bfloat16"), feat
+
+
+def measure(pred, feat, max_wait_us, clients, per_client=8):
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving import loadgen
+    rng = np.random.RandomState(0)
+    top = pred.max_batch
+    x_top = rng.rand(top, *feat).astype(np.float32)
+    pred.warmup()
+    raw_img_s = loadgen.raw_predict_rate(pred, x_top, steps=8)
+
+    with serving.DynamicBatcher(pred, max_wait_us=max_wait_us,
+                                max_queue=100_000,
+                                name=f"sweep{max_wait_us}") as bat:
+        x1 = rng.rand(1, *feat).astype(np.float32)
+        bat.predict(x1)
+        r = loadgen.closed_loop(bat, x1, clients, per_client,
+                                timeout=600)
+        rep = bat.report()
+    hot = max(rep["per_bucket"].items(),
+              key=lambda kv: kv[1]["batches"] or 0)
+    return {
+        "img_s": r["rows_s"],
+        "p50_ms": r["p50_ms"],
+        "p99_ms": r["p99_ms"],
+        "raw_img_s": raw_img_s,
+        "efficiency": r["rows_s"] / raw_img_s,
+        "hot_bucket": hot[0],
+        "occupancy": hot[1]["occupancy"],
+        "retraces": pred.retraces,
+    }
+
+
+def main():
+    args = [a for a in sys.argv[1:] if a != "--small"]
+    small = "--small" in sys.argv[1:]
+    specs = args or ["1,8,64:2000", "1,8,64:500", "1,16,128:2000"]
+    print(f"{'spec':>22}  {'img/s':>9}  {'p50 ms':>8}  {'p99 ms':>8}"
+          f"  {'eff':>6}  {'bucket':>6}  {'occ':>5}  retraces")
+    for spec in specs:
+        parts = spec.split(":")
+        if len(parts) < 2:
+            sys.exit(f"bad spec '{spec}': want buckets:max_wait_us"
+                     "[:clients]")
+        buckets = tuple(int(x) for x in parts[0].split(","))
+        wait_us = int(parts[1])
+        clients = int(parts[2]) if len(parts) > 2 else 64
+        pred, feat = build_predictor(buckets, batch=max(buckets),
+                                     small=small)
+        r = measure(pred, feat, wait_us, clients)
+        print(f"{spec:>22}  {r['img_s']:9.1f}  {r['p50_ms']:8.2f}"
+              f"  {r['p99_ms']:8.2f}  {r['efficiency']:6.3f}"
+              f"  {r['hot_bucket']:>6}  {r['occupancy'] or 0:5.2f}"
+              f"  {r['retraces']:8d}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
