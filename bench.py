@@ -380,6 +380,89 @@ def main():
     except Exception:
         pass
 
+    # -- phase E: fault tolerance — guard overhead + checkpoint latency -----
+    # The non-finite step guard (module/fused.py, MXTPU_FT_GUARD) rides
+    # inside the donated step program; its cost is one isfinite-reduce
+    # over the gradients plus where-selects on state. Acceptance bar:
+    # < 2% step time (pinned on the CPU proxy in tests; measured honestly
+    # here on the real chip). Checkpoint latency covers the sync save
+    # (step loop blocked) and the async submit (step loop resumes while
+    # bytes land) of the full ResNet-50 training state.
+    ft_stats = None
+    try:
+        import shutil
+        import tempfile
+        from mxnet_tpu.checkpoint import CheckpointManager
+
+        ab_steps = max(10, steps // 2)
+
+        def _rate(m, n):
+            def one(b):
+                m.forward(b, is_train=True)
+                m.backward()
+                m.update()
+            for b in host_batches:
+                one(b)
+            jax.block_until_ready(m._fused._pvals)
+            t0 = time.perf_counter()
+            for i in range(n):
+                one(host_batches[i % n_host])
+            jax.block_until_ready(m._fused._pvals)
+            return (time.perf_counter() - t0) / n
+
+        guarded_s = _rate(model, ab_steps)          # default guard: on
+        with mx.config.override("MXTPU_FT_GUARD", "0"):
+            m_ng = mx.mod.Module(context=mx.gpu(0), symbol=net,
+                                 fused=True, compute_dtype="bfloat16")
+            m_ng.bind(data_shapes=[("data", (batch, 3, 224, 224))],
+                      label_shapes=[("softmax_label", (batch,))])
+            m_ng.init_params(mx.init.Xavier(rnd_type="gaussian",
+                                            factor_type="in", magnitude=2))
+            m_ng.init_optimizer(kvstore=None, optimizer="sgd",
+                                optimizer_params={"learning_rate": 0.1,
+                                                  "momentum": 0.9,
+                                                  "wd": 1e-4})
+            unguarded_s = _rate(m_ng, ab_steps)
+
+        ck_dir = tempfile.mkdtemp(prefix="mxtpu_bench_ckpt_")
+        try:
+            mgr = CheckpointManager(ck_dir, keep=1, async_save=False)
+            t0 = time.perf_counter()
+            mgr.save_module(model, 1)
+            ckpt_sync_s = time.perf_counter() - t0
+            params_mb = sum(
+                os.path.getsize(os.path.join(r, f))
+                for r, _, fs in os.walk(ck_dir) for f in fs) / 1e6
+            mgr_a = CheckpointManager(ck_dir, keep=1, async_save=True)
+            t0 = time.perf_counter()
+            mgr_a.save_module(model, 2)
+            ckpt_submit_s = time.perf_counter() - t0
+            mgr_a.wait()
+            ckpt_async_total_s = time.perf_counter() - t0
+        finally:
+            shutil.rmtree(ck_dir, ignore_errors=True)
+
+        ft_stats = {
+            "guarded_step_s": round(guarded_s, 5),
+            "unguarded_step_s": round(unguarded_s, 5),
+            "guard_overhead": round(guarded_s / unguarded_s - 1.0, 4),
+            "guard_overhead_bar": "< 0.02 at the flagship config "
+                                  "(batch 128; tiny-batch runs are "
+                                  "update-dominated and read higher)",
+            "ckpt_save_s": round(ckpt_sync_s, 4),
+            "ckpt_async_submit_s": round(ckpt_submit_s, 4),
+            "ckpt_async_total_s": round(ckpt_async_total_s, 4),
+            "ckpt_size_mb": round(params_mb, 1),
+            "note": "guard = in-graph scalar grad-norm check; lax.cond "
+                    "keeps pre-step state on NaN/Inf (no retrace, no "
+                    "host sync); ckpt_save_s = atomic full-state "
+                    "checkpoint (params+opt+RNG+manifest CRC) with the "
+                    "step loop blocked; async submit returns after the "
+                    "host snapshot, files land on a background thread",
+        }
+    except Exception:
+        pass
+
     print(json.dumps({
         "metric": "resnet50_train_throughput_per_chip",
         "value": round(img_s, 2),
@@ -440,6 +523,7 @@ def main():
         "host_decode_per_core": decode_core,
         "host_decode_cores": host_cores,
         "resnet50_serving": serving_stats,
+        "fault_tolerance": ft_stats,
         "host_decode_note": "multiprocess RecordIO->decode->augment->"
                             "batch rate on 480-short-side packed records, "
                             "no device involved; host_decode_img_s = "

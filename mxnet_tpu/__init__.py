@@ -62,6 +62,11 @@ from . import visualization
 from . import visualization as viz
 from . import serving
 from .serving import serving_report
+from . import fault
+from .fault import fault_report
+from . import faultinject
+from . import checkpoint
+from .checkpoint import CheckpointManager
 from . import contrib
 from . import gluon
 from . import rnn

@@ -16,6 +16,7 @@ import numpy as np
 __all__ = [
     "MXNetError",
     "Registry",
+    "atomic_write",
     "get_env",
     "string_types",
     "numeric_types",
@@ -50,6 +51,63 @@ def get_env(name: str, default, dtype: Optional[type] = None):
     if dtype is bool:
         return val.lower() not in ("0", "false", "off", "")
     return dtype(val)
+
+
+import contextlib
+
+# probed ONCE at import (single-threaded): os.umask is a set-and-read
+# global, and atomic_write runs concurrently on checkpoint writer
+# threads — a per-call probe/restore dance would race and could leave
+# the process umask clobbered
+_UMASK = os.umask(0)
+os.umask(_UMASK)
+
+
+@contextlib.contextmanager
+def atomic_write(fname, mode="wb"):
+    """Crash-safe file write: temp file in the target directory → flush →
+    ``fsync`` → ``os.rename`` into place (+ directory fsync). A process
+    killed at ANY byte of the write leaves the previous file untouched —
+    the rename is the commit point (same discipline as the native.py
+    multi-process .so build). Every checkpoint-shaped write in the tree
+    (``nd.save``, ``.params``, ``-symbol.json``, optimizer ``.states``,
+    CheckpointManager files) goes through here.
+
+    Yields the file object to write to; the ``ckpt_write`` fault-injection
+    site (faultinject.py) can arm a byte-budgeted failure on it, so the
+    atomicity claim is testable deterministically (post-commit tearing is
+    the CheckpointManager-level ``ckpt_truncate`` site).
+    """
+    from . import faultinject
+    fname = os.fspath(fname)
+    d = os.path.dirname(os.path.abspath(fname))
+    import tempfile
+    fd, tmp = tempfile.mkstemp(dir=d,
+                               prefix="." + os.path.basename(fname) + ".",
+                               suffix=".tmp")
+    # mkstemp creates 0600; restore umask-honoring permissions so shared
+    # checkpoint dirs stay readable by eval/serving users (plain open()
+    # semantics, which this helper replaced)
+    os.chmod(tmp, 0o666 & ~_UMASK)
+    committed = False
+    try:
+        with os.fdopen(fd, mode) as f:
+            yield faultinject.guarded_write(f, path=fname)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, fname)
+        committed = True
+        try:
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass  # non-POSIX dir handles: rename already landed
+    finally:
+        if not committed and os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 class Registry:

@@ -1,0 +1,419 @@
+"""Atomic, self-validating training checkpoints with auto-resume.
+
+The reference's recovery story is per-epoch ``do_checkpoint`` files plus a
+parameter-server tracker that restarts dead jobs (SURVEY §5); a crash
+*during* the save corrupts the only copy. This manager closes that hole:
+
+- **Atomicity.** Every file is written temp+fsync+rename
+  (``base.atomic_write``), and a CRC-checksummed ``MANIFEST.json`` is
+  written LAST — a checkpoint without a valid manifest (killed mid-save)
+  or whose bytes don't match the manifest (torn/bit-rotted storage) is
+  *invalid by construction* and the loader falls back to the previous one.
+- **Completeness.** One checkpoint = params + aux + optimizer state +
+  epoch/batch cursor + global RNG state — enough to resume with zero
+  retraining of completed epochs and the same RNG stream a never-crashed
+  run would draw. The epoch's metric object rides along pickled
+  (``CheckpointState.metric``) as an inspection snapshot of training
+  quality at save time; resume happens at epoch boundaries where ``fit``
+  resets metrics, so it is not re-applied.
+- **Retention.** ``keep`` newest valid checkpoints survive
+  (``MXTPU_CKPT_KEEP``, default 3); stale and corrupt ones are pruned.
+- **Async save.** ``async_save=True`` (``MXTPU_CKPT_ASYNC``) snapshots
+  device state synchronously (host numpy copies off the donated fused
+  buffers) and writes files on a background thread, so the step loop
+  resumes while bytes land.
+
+``BaseModule.fit(checkpoint_manager=..., auto_resume=True)`` wires this
+into training: an epoch-end save of the full state, and on startup a
+restore from the newest *valid* checkpoint.
+
+Layout (one directory per checkpoint, ``<prefix>-NNNNNN/``):
+
+    params.params      arg:/aux: map, reference .params format
+    optimizer.states   fused/eager updater state bytes (optional)
+    extra.pkl          RNG snapshot + pickled metric + user extras
+    MANIFEST.json      {tag, epoch, nbatch, files: {name: {crc32, size}}}
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+import shutil
+import threading
+import time
+import zlib
+
+from . import fault
+from .base import MXNetError, atomic_write
+
+__all__ = ["CheckpointManager", "CheckpointState"]
+
+_MANIFEST = "MANIFEST.json"
+_PARAMS = "params.params"
+_OPT = "optimizer.states"
+_EXTRA = "extra.pkl"
+
+
+class CheckpointState:
+    """A loaded (validated) checkpoint."""
+
+    def __init__(self, path, tag, meta, arg_params, aux_params,
+                 opt_states=None, rng=None, metric=None, extra=None):
+        self.path = path
+        self.tag = tag
+        self.epoch = int(meta.get("epoch", tag))
+        self.nbatch = int(meta.get("nbatch", 0))
+        self.num_update = int(meta.get("num_update", 0))
+        self.meta = meta
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.opt_states = opt_states
+        self.rng = rng
+        self.metric = metric
+        self.extra = extra
+
+    def __repr__(self):
+        return (f"CheckpointState(tag={self.tag}, epoch={self.epoch}, "
+                f"nbatch={self.nbatch}, path={self.path!r})")
+
+
+def _crc_file(path):
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+class CheckpointManager:
+    """See module docstring. Thread-safe for the fit-loop usage pattern:
+    one producer calling :meth:`save_module`, readers validating/loading.
+    """
+
+    def __init__(self, directory, prefix="ckpt", keep=None, async_save=None,
+                 save_optimizer_states=True, logger=None):
+        from . import config
+        self.directory = os.fspath(directory)
+        self.prefix = prefix
+        self.keep = int(config.get("MXTPU_CKPT_KEEP")) if keep is None \
+            else int(keep)
+        self.async_save = bool(config.get("MXTPU_CKPT_ASYNC")) \
+            if async_save is None else bool(async_save)
+        self.save_optimizer_states = save_optimizer_states
+        self.logger = logger or logging.getLogger("mxnet_tpu.checkpoint")
+        os.makedirs(self.directory, exist_ok=True)
+        self._thread = None
+        self._bg_error = None
+        self._lock = threading.Lock()
+        self._valid_tags = set()   # tags this process wrote/validated
+        from . import profiler
+        self._dom = profiler.Domain("ft")
+
+    # -- naming ---------------------------------------------------------------
+    def _dir_for(self, tag):
+        return os.path.join(self.directory, f"{self.prefix}-{tag:06d}")
+
+    def _tags(self):
+        """Existing checkpoint tags, newest first."""
+        pre = self.prefix + "-"
+        tags = []
+        for name in os.listdir(self.directory):
+            if name.startswith(pre) and name[len(pre):].isdigit() and \
+                    os.path.isdir(os.path.join(self.directory, name)):
+                tags.append(int(name[len(pre):]))
+        return sorted(tags, reverse=True)
+
+    # -- save -----------------------------------------------------------------
+    def save_module(self, module, epoch, nbatch=0, eval_metric=None,
+                    extra=None):
+        """Snapshot a bound+initialized Module into checkpoint ``epoch``
+        (the tag doubles as the resume cursor: "next epoch to run").
+        Device state is pulled to host HERE (``get_params`` syncs the
+        fused donated buffers); with ``async_save`` the file writes then
+        happen on a background thread off those host copies."""
+        arg_params, aux_params = module.get_params()
+        args_np = {k: v.asnumpy() for k, v in arg_params.items()}
+        auxs_np = {k: v.asnumpy() for k, v in aux_params.items()}
+        opt_bytes = None
+        if self.save_optimizer_states and \
+                getattr(module, "optimizer_initialized", False):
+            opt_bytes = _opt_state_bytes(module)
+        from . import random as _random
+        payload = {
+            "rng": _random.get_state(),
+            "metric": _pickle_or_none(eval_metric),
+            "extra": extra,
+        }
+        meta = {
+            "tag": int(epoch), "epoch": int(epoch), "nbatch": int(nbatch),
+            "num_update": int(getattr(getattr(module, "_fused", None),
+                                      "num_update", 0) or
+                              getattr(getattr(module, "_optimizer", None),
+                                      "num_update", 0) or 0),
+            "time": time.time(),
+        }
+        sym = getattr(module, "_symbol", None)
+        if sym is not None:
+            try:  # once per job: symbol graph for file-level interop
+                sym_path = os.path.join(self.directory,
+                                        f"{self.prefix}-symbol.json")
+                if not os.path.exists(sym_path):
+                    sym.save(sym_path)
+            except Exception:
+                pass
+        return self.save_state(args_np, auxs_np, meta, opt_bytes, payload)
+
+    def save_state(self, args_np, auxs_np, meta, opt_bytes=None,
+                   payload=None):
+        """Write one checkpoint from already-host-resident state."""
+        self.wait()  # one in-flight background save at a time
+        if self.async_save:
+            t = threading.Thread(
+                target=self._write_guarded,
+                args=(args_np, auxs_np, meta, opt_bytes, payload),
+                name="mxtpu-ckpt-save", daemon=True)
+            with self._lock:
+                self._thread = t
+            t.start()
+            fault.count("ckpt.async_saves")
+            return self._dir_for(meta["tag"])
+        return self._write(args_np, auxs_np, meta, opt_bytes, payload)
+
+    def wait(self):
+        """Join any in-flight async save; re-raise its failure."""
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+        with self._lock:
+            err, self._bg_error = self._bg_error, None
+        if err is not None:
+            raise err
+
+    def _write_guarded(self, *args):
+        try:
+            self._write(*args)
+        except BaseException as e:  # surfaced on wait()/next save
+            with self._lock:
+                self._bg_error = e
+            fault.count("ckpt.save_errors")
+
+    def _write(self, args_np, auxs_np, meta, opt_bytes, payload):
+        from .ndarray.param_file import dumps_params
+        tag = meta["tag"]
+        ckpt_dir = self._dir_for(tag)
+        t0 = time.perf_counter()
+        with self._dom.new_task("save"):
+            os.makedirs(ckpt_dir, exist_ok=True)
+            self._valid_tags.discard(tag)
+            stale = os.path.join(ckpt_dir, _MANIFEST)
+            if os.path.exists(stale):
+                os.unlink(stale)  # re-save of a tag: invalidate first
+            # serialize each payload in memory (raw numpy straight into
+            # the .params encoder — no device round trip) and CRC the
+            # exact bytes BEFORE they hit disk: the manifest never needs
+            # to re-read what it just wrote, halving save I/O; the
+            # loader's validate() is the read-side corruption check
+            save_dict = {f"arg:{k}": v for k, v in args_np.items()}
+            save_dict.update({f"aux:{k}": v for k, v in auxs_np.items()})
+            blobs = {_PARAMS: dumps_params(list(save_dict.values()),
+                                           list(save_dict.keys())),
+                     _EXTRA: pickle.dumps(payload or {})}
+            if opt_bytes is not None:
+                blobs[_OPT] = opt_bytes
+            for name in (_PARAMS, _OPT, _EXTRA):
+                # a re-save of this tag writing FEWER files must not
+                # leave an earlier save's stale payload behind (it would
+                # sit unlisted in the new manifest, CRC-unchecked)
+                p = os.path.join(ckpt_dir, name)
+                if name not in blobs and os.path.exists(p):
+                    os.unlink(p)
+            files = {}
+            for name, blob in blobs.items():
+                with atomic_write(os.path.join(ckpt_dir, name)) as f:
+                    f.write(blob)
+                files[name] = {"crc32": zlib.crc32(blob) & 0xFFFFFFFF,
+                               "size": len(blob)}
+            manifest = dict(meta, files=files, version=1)
+            # the commit point: a checkpoint IS valid iff this file lands
+            # intact and its checksums match the payload files
+            with atomic_write(os.path.join(ckpt_dir, _MANIFEST),
+                              mode="w") as f:
+                json.dump(manifest, f, indent=1)
+            # chaos hook: 'ckpt_truncate' tears a payload file AFTER the
+            # manifest committed — storage lying below the rename; the
+            # recorded CRC is what must catch it on load
+            from . import faultinject
+            for name in files:
+                faultinject.maybe_truncate(os.path.join(ckpt_dir, name))
+        fault.count("ckpt.saves")
+        self._valid_tags.add(tag)
+        self._last_save_s = time.perf_counter() - t0
+        self.logger.info("Saved checkpoint '%s' (epoch %s, %.3fs)",
+                         ckpt_dir, meta.get("epoch"), self._last_save_s)
+        self.prune()
+        return ckpt_dir
+
+    # -- validate / load -------------------------------------------------------
+    def validate(self, ckpt_dir):
+        """True iff the manifest parses and every payload file matches
+        its recorded CRC32 + size (detects truncation, torn writes, and
+        corruption)."""
+        mpath = os.path.join(ckpt_dir, _MANIFEST)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+            files = manifest["files"]
+            if _PARAMS not in files:
+                return False
+            for name, rec in files.items():
+                p = os.path.join(ckpt_dir, name)
+                if os.path.getsize(p) != rec["size"] or \
+                        _crc_file(p) != rec["crc32"]:
+                    return False
+            return True
+        except (OSError, ValueError, KeyError):
+            return False
+
+    def load(self, tag):
+        """Load one checkpoint by tag; raises if invalid."""
+        ckpt_dir = self._dir_for(tag)
+        if not self.validate(ckpt_dir):
+            raise MXNetError(f"checkpoint '{ckpt_dir}' is missing or "
+                             "corrupt (manifest/CRC mismatch)")
+        return self._load_dir(ckpt_dir, tag)
+
+    def _load_dir(self, ckpt_dir, tag):
+        from . import ndarray as nd
+        with open(os.path.join(ckpt_dir, _MANIFEST)) as f:
+            meta = json.load(f)
+        # only files the manifest LISTS are part of the checkpoint —
+        # an unlisted stray (older save of the same tag) is not CRC
+        # covered and must not be restored
+        listed = meta.get("files", {})
+        save_dict = nd.load(os.path.join(ckpt_dir, _PARAMS))
+        arg_params, aux_params = {}, {}
+        for k, v in save_dict.items():
+            tp, name = k.split(":", 1)
+            (arg_params if tp == "arg" else aux_params)[name] = v
+        opt_states = None
+        if _OPT in listed:
+            with open(os.path.join(ckpt_dir, _OPT), "rb") as f:
+                opt_states = f.read()
+        payload = {}
+        if _EXTRA in listed:
+            with open(os.path.join(ckpt_dir, _EXTRA), "rb") as f:
+                payload = pickle.loads(f.read())
+        return CheckpointState(ckpt_dir, tag, meta, arg_params, aux_params,
+                               opt_states=opt_states,
+                               rng=payload.get("rng"),
+                               metric=payload.get("metric"),
+                               extra=payload.get("extra"))
+
+    def load_latest(self):
+        """Newest VALID checkpoint, or None. Corrupt/truncated/partial
+        checkpoints are detected (manifest CRC), counted, logged, and
+        skipped — the fallback walk is the recovery guarantee."""
+        self.wait()
+        with self._dom.new_task("load"):
+            for tag in self._tags():
+                ckpt_dir = self._dir_for(tag)
+                if self.validate(ckpt_dir):
+                    self._valid_tags.add(tag)
+                    return self._load_dir(ckpt_dir, tag)
+                fault.count("ckpt.corrupt_detected")
+                fault.count("ckpt.fallbacks")
+                self.logger.warning(
+                    "checkpoint '%s' failed validation (torn write or "
+                    "corruption); falling back to the previous one",
+                    ckpt_dir)
+        return None
+
+    # -- restore ---------------------------------------------------------------
+    def restore(self, module, state=None, load_optimizer=True,
+                restore_rng=True):
+        """Apply a checkpoint to a bound module (params + aux always;
+        optimizer state when initialized; global RNG stream). Returns the
+        state used, or None when no valid checkpoint exists."""
+        if state is None:
+            state = self.load_latest()
+        if state is None:
+            return None
+        module.set_params(state.arg_params, state.aux_params)
+        if load_optimizer and state.opt_states is not None and \
+                getattr(module, "optimizer_initialized", False):
+            _apply_opt_state(module, state.opt_states)
+        if restore_rng and state.rng is not None:
+            from . import random as _random
+            _random.set_state(state.rng)
+        fault.count("ckpt.restores")
+        return state
+
+    # -- retention -------------------------------------------------------------
+    def prune(self):
+        """Keep the ``keep`` newest valid checkpoints; remove older ones
+        and any invalid (partial/corrupt) directory."""
+        if self.keep <= 0:
+            return
+        valid_seen = 0
+        for tag in self._tags():
+            ckpt_dir = self._dir_for(tag)
+            # checkpoints this process wrote (or already validated) skip
+            # the CRC re-read: prune runs after EVERY save, and a full
+            # re-checksum of keep x checkpoint-size per epoch is real
+            # disk traffic on the async path. load_latest still always
+            # re-validates — pruning trusts the cache, recovery doesn't.
+            if tag in self._valid_tags or self.validate(ckpt_dir):
+                self._valid_tags.add(tag)
+                valid_seen += 1
+                if valid_seen > self.keep:
+                    shutil.rmtree(ckpt_dir, ignore_errors=True)
+                    self._valid_tags.discard(tag)
+                    fault.count("ckpt.pruned")
+            elif valid_seen > 0:
+                # older than a valid checkpoint and broken: dead weight
+                shutil.rmtree(ckpt_dir, ignore_errors=True)
+                fault.count("ckpt.pruned_corrupt")
+
+    def stats(self):
+        return {"last_save_s": getattr(self, "_last_save_s", None),
+                "tags": self._tags(), "keep": self.keep,
+                "async": self.async_save}
+
+
+def _opt_state_bytes(module):
+    """Serialized optimizer state for any Module update regime."""
+    fused = getattr(module, "_fused", None)
+    if fused is not None:
+        return fused.get_states()
+    if getattr(module, "_update_on_kvstore", False):
+        upd = getattr(module._kvstore, "_updater", None)
+        return upd.get_states() if upd is not None else None
+    upd = getattr(module, "_updater", None)
+    return upd.get_states() if upd is not None else None
+
+
+def _apply_opt_state(module, data):
+    fused = getattr(module, "_fused", None)
+    if fused is not None:
+        fused.set_states(data)
+        return
+    if getattr(module, "_update_on_kvstore", False):
+        upd = getattr(module._kvstore, "_updater", None)
+        if upd is not None:
+            upd.set_states(data)
+        return
+    upd = getattr(module, "_updater", None)
+    if upd is not None:
+        upd.set_states(data)
+
+
+def _pickle_or_none(obj):
+    if obj is None:
+        return None
+    try:
+        return pickle.dumps(obj)
+    except Exception:
+        return None

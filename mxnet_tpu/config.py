@@ -117,6 +117,33 @@ register("MXTPU_SERVING_MAX_WAIT_US", 2000, int,
 register("MXTPU_SERVING_MAX_QUEUE", 256, int,
          "DynamicBatcher admission bound in queued ROWS; submits past "
          "it fail fast with serving.Overloaded (load shedding)")
+register("MXTPU_CKPT_KEEP", 3, int,
+         "CheckpointManager retention: newest K valid checkpoints "
+         "survive pruning (checkpoint.py)")
+register("MXTPU_CKPT_ASYNC", False, bool,
+         "CheckpointManager default: snapshot state synchronously but "
+         "write checkpoint files on a background thread")
+register("MXTPU_FT_GUARD", "auto", str,
+         "Non-finite-step guard compiled into the fused train step: "
+         "NaN/Inf gradients skip the update in-graph (params/optimizer "
+         "state kept, counter bumped). 1/auto = on, 0 = off")
+register("MXTPU_FT_MAX_CONSEC_SKIPS", 0, int,
+         "Abort training (MXNetError) once this many CONSECUTIVE steps "
+         "were guard-skipped (checked laggedly, no per-step sync); "
+         "0 disables the abort")
+register("MXTPU_FT_DIST_RETRIES", 3, int,
+         "Retry count for dist init/barrier transport failures "
+         "(exponential backoff, parallel/dist.py)")
+register("MXTPU_FT_DIST_BACKOFF", 0.5, float,
+         "Initial backoff seconds between dist retries (doubles per "
+         "attempt)")
+register("MXTPU_FT_DIST_DEADLINE", 120.0, float,
+         "Total seconds budget across dist retries and the host-level "
+         "fallback collective's blocking KV reads/barriers")
+register("MXTPU_FAULT_INJECT", "", str,
+         "Deterministic fault-injection spec, 'site:k=v[:k=v];site2:...' "
+         "(faultinject.py) — e.g. 'ckpt_write:byte=100:action=kill', "
+         "'nan_grad:step=3'. Empty = no faults. Test-only")
 
 
 def _autostart_profiler():

@@ -1,0 +1,111 @@
+"""Fault-tolerance observability: process-wide counters + ``fault_report``.
+
+The single sink every fault-tolerance mechanism reports into — the
+non-finite step guard (module/fused.py), the CheckpointManager
+(checkpoint.py), the hardened dist transport (parallel/dist.py), and the
+fault-injection harness (faultinject.py). ``mx.fault_report()`` is the one
+sync point: reading it pulls the guard's device counters to host (the
+guard itself never host-syncs per step).
+
+Modeled on ``mx.serving_report()`` (serving/__init__.py): module-level
+registry, weakrefs to live producers, ``reset=True`` to zero between
+measurement windows.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+__all__ = ["count", "add", "counters", "register_guard", "fault_report"]
+
+_lock = threading.Lock()
+_counters = {}
+_guards = []        # weakrefs to live FusedSymbolStep instances
+
+
+def count(name, delta=1):
+    """Bump a named counter (dot-namespaced: ``ckpt.saves``,
+    ``dist.collective_fallbacks``, ``injected.nan_grad``...)."""
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + delta
+
+
+add = count
+
+
+def counters():
+    with _lock:
+        return dict(_counters)
+
+
+def register_guard(step):
+    """Track a live guarded FusedSymbolStep; ``fault_report`` sums the
+    skip counters across every live instance."""
+    with _lock:
+        _guards[:] = [wr for wr in _guards if wr() is not None]
+        _guards.append(weakref.ref(step))
+
+
+_prof_counter = [None]
+
+
+def _update_prof_counter(val):
+    """Mirror the guard's skip total into a profiler ``ft::`` counter so
+    traces/aggregates show it alongside the ``ft::save``/``ft::load``
+    spans (checkpoint.py) and ``ft::dist_retry`` (parallel/dist.py)."""
+    try:
+        from . import profiler
+        if _prof_counter[0] is None:
+            _prof_counter[0] = profiler.Counter(
+                profiler.Domain("ft"), "skipped_steps")
+        _prof_counter[0].set_value(val)
+    except Exception:
+        pass
+
+
+def fault_report(reset=False):
+    """Aggregate fault-tolerance state:
+
+    - ``skipped_steps`` / ``consecutive_skips``: non-finite training steps
+      the in-graph guard where'd out (summed / maxed over live guarded
+      steps; reading syncs their device counters — this is the intended
+      sync point, the step itself never blocks),
+    - ``checkpoint``: saves / async saves / fallbacks / corrupt
+      checkpoints detected,
+    - ``dist``: init retries, host-collective fallbacks,
+    - ``injected``: per-site fault-injection fire counts.
+    """
+    import numpy as np
+    skipped = 0
+    consec = 0
+    guard_active = False
+    with _lock:
+        guards = [wr() for wr in _guards]
+    for g in guards:
+        if g is None or getattr(g, "_fault_state", None) is None:
+            continue
+        guard_active = guard_active or g.guard_enabled
+        total, cons = (int(x) for x in np.asarray(g._fault_state))
+        skipped += total
+        consec = max(consec, cons)
+        if reset:
+            g.reset_fault_state()
+    _update_prof_counter(skipped)
+    with _lock:
+        cs = dict(_counters)
+        if reset:
+            _counters.clear()
+
+    def _sub(prefix):
+        plen = len(prefix) + 1
+        return {k[plen:]: v for k, v in cs.items()
+                if k.startswith(prefix + ".")}
+
+    return {
+        "skipped_steps": skipped,
+        "consecutive_skips": consec,
+        "guard_active": guard_active,
+        "checkpoint": _sub("ckpt"),
+        "dist": _sub("dist"),
+        "injected": _sub("injected"),
+    }

@@ -1,0 +1,258 @@
+"""Deterministic fault-injection harness for the fault-tolerance layer.
+
+Faults are armed **by site and ordinal**, never randomly: a spec names a
+site (``ckpt_write``, ``nan_grad``, ``data_iter``, ``dist_drop``,
+``dist_init``, ``ckpt_truncate``) plus the exact coordinate at which it
+fires (byte offset, step index, batch index, call ordinal). The same spec
+always produces the same failure, so CI chaos suites are reproducible
+bit-for-bit (contrast: the classic chaos-monkey coin flip, useless as a
+regression gate).
+
+Two arming surfaces, merged innermost-wins:
+
+- env ``MXTPU_FAULT_INJECT`` — ``"site:key=val[:key=val];site2:..."``,
+  inherited by subprocesses (how the kill-during-checkpoint resume test
+  arms the child), and
+- the ``inject(...)`` context manager for in-process tests.
+
+Sites are *consulted* by production code via :func:`fire` (or
+:func:`guarded_write` for byte-budgeted storage writes); an unarmed site
+costs one dict lookup and no lock. Firing either raises
+:class:`FaultInjected` (an ``OSError``, so storage sites propagate
+through generic I/O handling) or, with ``action=kill``, SIGKILLs the
+process — the honest simulation of a machine loss mid-write.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["FaultInjected", "inject", "active", "fire", "guarded_write",
+           "maybe_truncate", "reset", "fired"]
+
+
+class FaultInjected(OSError):
+    """Raised at an armed fault site (subclasses OSError so storage-site
+    failures take the same handling path as real I/O errors)."""
+
+    def __init__(self, site, **ctx):
+        detail = ", ".join(f"{k}={v}" for k, v in sorted(ctx.items()))
+        super().__init__(f"injected fault at site '{site}' ({detail})")
+        self.site = site
+        self.ctx = ctx
+
+
+_lock = threading.Lock()
+_stack = []        # programmatic layers: list of {site: params}
+_consults = {}     # site -> times fire() was consulted (the implicit 'call')
+_fired = {}        # site -> times the site actually fired
+_env_cache = (None, {})   # (raw MXTPU_FAULT_INJECT string, parsed spec)
+
+
+def parse_spec(spec):
+    """``"site:k=v:k2=v2;site2:..."`` -> {site: {k: v}} (ints parsed)."""
+    out = {}
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        params = {}
+        for kv in fields[1:]:
+            k, _, v = kv.partition("=")
+            try:
+                params[k.strip()] = int(v)
+            except ValueError:
+                params[k.strip()] = v.strip()
+        out[fields[0].strip()] = params
+    return out
+
+
+def active(site):
+    """The armed params for ``site`` (innermost ``inject`` layer wins,
+    then the env spec), or None when unarmed. The unarmed fast path —
+    every fused train step and every batch consult it — is lock-free:
+    one list truthiness check plus one env lookup, with the parsed env
+    spec cached against the raw string."""
+    global _env_cache
+    if _stack:                      # racy read is fine: arming is scoped
+        with _lock:
+            for layer in reversed(_stack):
+                if site in layer:
+                    return dict(layer[site])
+    env = os.environ.get("MXTPU_FAULT_INJECT")
+    if not env:
+        return None
+    if _env_cache[0] != env:
+        _env_cache = (env, parse_spec(env))
+    return _env_cache[1].get(site)
+
+
+class inject:
+    """Arm fault sites for a ``with`` scope::
+
+        with faultinject.inject("nan_grad:step=3"):
+            ...
+        with faultinject.inject(dist_drop={"call": 1}):
+            ...
+
+    Layers nest; site counters reset on entry so ordinals are scoped to
+    the injection, not process lifetime.
+    """
+
+    def __init__(self, spec=None, **sites):
+        layer = parse_spec(spec) if isinstance(spec, str) else dict(spec or {})
+        for site, params in sites.items():
+            layer[site] = dict(params)
+        self._layer = layer
+
+    def __enter__(self):
+        with _lock:
+            _stack.append(self._layer)
+            for site in self._layer:
+                _consults.pop(site, None)
+                _fired.pop(site, None)
+        return self
+
+    def __exit__(self, *exc):
+        with _lock:
+            _stack.remove(self._layer)
+
+
+def _matches(params, ctx):
+    """Every armed coordinate present in ``ctx`` must equal it; ``times``
+    and ``action`` are modifiers, not coordinates."""
+    for k, v in params.items():
+        if k in ("times", "action", "byte", "bytes", "match"):
+            continue
+        if k in ctx and ctx[k] != v:
+            return False
+    return True
+
+
+def _record_fire(site):
+    _fired[site] = _fired.get(site, 0) + 1
+    try:                                    # observability, never load-bearing
+        from . import fault
+        fault.count(f"injected.{site}")
+    except Exception:
+        pass
+
+
+def fire(site, **ctx):
+    """Consult a site. Returns True exactly when the armed coordinates
+    match ``ctx`` (an implicit 1-based ``call`` ordinal is supplied for
+    sites armed on ``call=N``). Honors ``times=N`` (fire at most N times).
+    """
+    params = active(site)
+    if params is None:
+        return False
+    with _lock:
+        _consults[site] = _consults.get(site, 0) + 1
+        ctx.setdefault("call", _consults[site])
+        if not _matches(params, ctx):
+            return False
+        if "times" in params and _fired.get(site, 0) >= params["times"]:
+            return False
+        _record_fire(site)
+    if params.get("action") == "kill":
+        _sigkill(site)
+    return True
+
+
+def fired(site):
+    """How many times ``site`` has fired (test assertion helper)."""
+    with _lock:
+        return _fired.get(site, 0)
+
+
+def reset():
+    """Clear all ordinal/fired counters (between test cases)."""
+    with _lock:
+        _consults.clear()
+        _fired.clear()
+
+
+def _sigkill(site):
+    import signal
+    import sys
+    print(f"faultinject: SIGKILL at site '{site}'", flush=True)
+    sys.stdout.flush()
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+# -- storage sites -----------------------------------------------------------
+
+class _ByteBudgetFile:
+    """File proxy that dies after ``byte`` bytes have been written: the
+    prefix that fits is written for real (a torn write, not a clean
+    no-op), then the armed action runs — raise :class:`FaultInjected`
+    or SIGKILL (``action=kill``)."""
+
+    def __init__(self, fobj, site, params, path):
+        self._f = fobj
+        self._site = site
+        self._params = params
+        self._path = path
+        self._written = 0
+        self._budget = params.get("byte")
+
+    def write(self, data):
+        if self._budget is not None and \
+                self._written + len(data) > self._budget:
+            keep = max(0, self._budget - self._written)
+            if keep:
+                self._f.write(data[:keep])
+            self._f.flush()
+            self._written += keep
+            with _lock:
+                _record_fire(self._site)
+            if self._params.get("action") == "kill":
+                os.fsync(self._f.fileno())
+                _sigkill(self._site)
+            raise FaultInjected(self._site, path=self._path,
+                                byte=self._budget)
+        self._written += len(data)
+        return self._f.write(data)
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+
+def guarded_write(fobj, path=None, site="ckpt_write"):
+    """Wrap an open file with the ``ckpt_write`` byte-budget site (no-op
+    when unarmed or when ``match=`` doesn't hit ``path``). ``call=N``
+    arms only the N-th matching file — how the resume test kills the
+    epoch-3 checkpoint write specifically, leaving epoch 2 good."""
+    params = active(site)
+    if params is None:
+        return fobj
+    match = params.get("match")
+    if match and (path is None or match not in os.path.basename(path)):
+        return fobj
+    if "call" in params:
+        with _lock:
+            _consults[site] = _consults.get(site, 0) + 1
+            if _consults[site] != params["call"]:
+                return fobj
+    return _ByteBudgetFile(fobj, site, params, path)
+
+
+def maybe_truncate(path, site="ckpt_truncate"):
+    """``ckpt_truncate:bytes=N[:match=substr]`` — after a file lands,
+    truncate it to N bytes (simulates torn storage below the rename,
+    e.g. a lying disk cache): the checkpoint loader must detect this
+    via the CRC manifest and fall back."""
+    params = active(site)
+    if params is None:
+        return
+    match = params.get("match")
+    if match and match not in os.path.basename(path):
+        return
+    n = params.get("bytes", 0)
+    if os.path.getsize(path) <= n:
+        return
+    with _lock:
+        _record_fire(site)
+    with open(path, "rb+") as f:
+        f.truncate(n)

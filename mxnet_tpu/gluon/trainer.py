@@ -170,7 +170,8 @@ class Trainer:
     def save_states(self, fname):
         """Save optimizer/updater states (reference: trainer.py:202)."""
         assert self._optimizer is not None
-        with open(fname, "wb") as fout:
+        from ..base import atomic_write
+        with atomic_write(fname) as fout:
             fout.write(self._updaters[0].get_states(dump_optimizer=True))
 
     def load_states(self, fname):

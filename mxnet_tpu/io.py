@@ -98,6 +98,15 @@ class DataIter:
         raise StopIteration
 
     def __next__(self):
+        # deterministic fault site: 'data_iter:batch=B' raises at this
+        # iterator's B-th batch (1-based) — the chaos suites' stand-in
+        # for a dying input-pipeline worker
+        from . import faultinject
+        if faultinject.active("data_iter") is not None:
+            self._fi_ordinal = getattr(self, "_fi_ordinal", 0) + 1
+            if faultinject.fire("data_iter", batch=self._fi_ordinal):
+                raise faultinject.FaultInjected(
+                    "data_iter", batch=self._fi_ordinal)
         return self.next()
 
     def iter_next(self):

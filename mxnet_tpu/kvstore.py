@@ -227,7 +227,8 @@ class KVStore:
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "Cannot save states for distributed training"
-        with open(fname, "wb") as fout:
+        from .base import atomic_write
+        with atomic_write(fname) as fout:
             fout.write(self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):

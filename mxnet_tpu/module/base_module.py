@@ -7,6 +7,7 @@ forward_backward :189, predict :238).
 from __future__ import annotations
 
 import logging
+import os
 import time
 import warnings
 
@@ -176,12 +177,28 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
-        """The full training loop (reference: base_module.py:376)."""
+            monitor=None, sparse_row_id_fn=None,
+            checkpoint_manager=None, auto_resume=False):
+        """The full training loop (reference: base_module.py:376).
+
+        ``checkpoint_manager`` (a ``mx.checkpoint.CheckpointManager`` or a
+        directory path) saves the FULL training state — params, optimizer
+        state, epoch cursor, RNG stream, metric values — atomically at
+        every epoch end; ``auto_resume=True`` restores the newest *valid*
+        checkpoint before training, skipping every completed epoch (a
+        corrupt/torn newest checkpoint falls back to the previous one).
+        """
         from .. import initializer as init_mod
         assert num_epoch is not None, "please specify number of epochs"
         if initializer is None:
             initializer = init_mod.Uniform(0.01)
+        if checkpoint_manager is None and auto_resume:
+            raise ValueError(
+                "fit(auto_resume=True) needs checkpoint_manager= (a "
+                "CheckpointManager or a checkpoint directory path)")
+        if isinstance(checkpoint_manager, (str, bytes, os.PathLike)):
+            from ..checkpoint import CheckpointManager
+            checkpoint_manager = CheckpointManager(checkpoint_manager)
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label, for_training=True,
@@ -193,6 +210,14 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+
+        if checkpoint_manager is not None and auto_resume:
+            resumed = checkpoint_manager.restore(self)
+            if resumed is not None:
+                begin_epoch = max(begin_epoch, resumed.epoch)
+                self.logger.info(
+                    "Auto-resume from checkpoint '%s': continuing at "
+                    "epoch %d", resumed.path, begin_epoch)
 
         if validation_metric is None:
             validation_metric = eval_metric
@@ -247,6 +272,13 @@ class BaseModule:
                 for callback in _as_list(epoch_end_callback):
                     callback(epoch, self.symbol, arg_params_, aux_params_)
 
+            if checkpoint_manager is not None:
+                # tag epoch+1 == the next epoch to run: auto_resume picks
+                # it up as begin_epoch, so completed epochs never rerun
+                checkpoint_manager.save_module(self, epoch + 1,
+                                               nbatch=nbatch,
+                                               eval_metric=eval_metric)
+
             if eval_data is not None:
                 res = self.score(eval_data, validation_metric,
                                  score_end_callback=eval_end_callback,
@@ -256,6 +288,13 @@ class BaseModule:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                      name, val)
             train_data.reset()
+
+        if checkpoint_manager is not None:
+            # drain an in-flight async save before returning: the caller
+            # may exit immediately, and a daemon writer killed mid-write
+            # would leave the final checkpoint torn; this also re-raises
+            # any background save failure instead of swallowing it
+            checkpoint_manager.wait()
 
     # -- misc ------------------------------------------------------------------
     def get_input_grads(self, merge_multi_context=True):

@@ -617,13 +617,14 @@ class Module(BaseModule):
     def save_optimizer_states(self, fname):
         """(reference: module.py:759)"""
         assert self.optimizer_initialized
+        from ..base import atomic_write
         if self._fused is not None:
-            with open(fname, "wb") as fout:
+            with atomic_write(fname) as fout:
                 fout.write(self._fused.get_states())
         elif self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as fout:
+            with atomic_write(fname) as fout:
                 fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):

@@ -194,7 +194,8 @@ def save(fname, data):
         save_params(fname, arrs, names if names is not None else [])
         return
     names = names if names is not None else [str(i) for i in range(len(arrs))]
-    with open(fname, "wb") as f:
+    from ..base import atomic_write
+    with atomic_write(fname) as f:
         np.savez(f, __mxnet_tpu_names__=np.array(names, dtype=object),
                  **{f"arr_{i}": a.asnumpy() for i, a in enumerate(arrs)})
 

@@ -141,9 +141,10 @@ def _load_one(buf: memoryview, pos: int):
     return data.copy(), pos + n * dtype.itemsize
 
 
-def save_params(fname: str, arrays: Sequence, names: Sequence[str]):
-    """Write a reference-format .params file
-    (reference: NDArray::Save ndarray.cc:1769, MXNDArraySave c_api.cc:272)."""
+def dumps_params(arrays: Sequence, names: Sequence[str]) -> bytes:
+    """Serialize to the reference .params byte format in memory (lets
+    callers checksum the exact bytes without re-reading the file —
+    CheckpointManager builds its CRC manifest from this)."""
     out: List[bytes] = [struct.pack("<QQ", _LIST_MAGIC, 0),
                         struct.pack("<Q", len(arrays))]
     for a in arrays:
@@ -152,8 +153,15 @@ def save_params(fname: str, arrays: Sequence, names: Sequence[str]):
     for n in names:
         b = n.encode("utf-8")
         out.append(struct.pack("<Q", len(b)) + b)
-    with open(fname, "wb") as f:
-        f.write(b"".join(out))
+    return b"".join(out)
+
+
+def save_params(fname: str, arrays: Sequence, names: Sequence[str]):
+    """Write a reference-format .params file
+    (reference: NDArray::Save ndarray.cc:1769, MXNDArraySave c_api.cc:272)."""
+    from ..base import atomic_write
+    with atomic_write(fname) as f:
+        f.write(dumps_params(arrays, names))
 
 
 def load_params(fname: str) -> Tuple[list, List[str]]:

@@ -20,20 +20,61 @@ Architectural mapping:
 from __future__ import annotations
 
 import os
+import time
+import warnings
 
 import numpy as np
 
+from .. import fault
+from ..base import MXNetError
 from ..kvstore import KVStore
 from ..ndarray.ndarray import NDArray, _wrap
 
 __all__ = ["DistKVStore", "init", "barrier", "allreduce"]
 
 _initialized = [False]
+_host_fallback = [False]    # sticky: backend lacks multiproc collectives
+_host_seq = [0]             # per-process collective ordinal (SPMD-matched)
+_barrier_seq = [0]
+
+
+def _ft_cfg():
+    from .. import config
+    return (int(config.get("MXTPU_FT_DIST_RETRIES")),
+            float(config.get("MXTPU_FT_DIST_BACKOFF")),
+            float(config.get("MXTPU_FT_DIST_DEADLINE")))
+
+
+def _retry(fn, what):
+    """Run ``fn`` with exponential backoff + a wall-clock deadline —
+    transient transport failures (coordinator not yet listening, slow
+    rendezvous, injected faults) degrade to retries instead of killing
+    the job (reference analog: ps-lite's van resends; SURVEY §5)."""
+    retries, backoff, deadline = _ft_cfg()
+    t0 = time.monotonic()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as e:
+            attempt += 1
+            elapsed = time.monotonic() - t0
+            if attempt > retries or elapsed >= deadline:
+                raise MXNetError(
+                    f"dist {what} failed after {attempt} attempt(s) / "
+                    f"{elapsed:.1f}s (retries={retries}, "
+                    f"deadline={deadline}s): {e}") from e
+            fault.count(f"dist.{what}_retries")
+            from .. import profiler
+            with profiler.Domain("ft").new_task(f"dist_retry_{what}"):
+                time.sleep(min(backoff * (2 ** (attempt - 1)),
+                               max(0.0, deadline - elapsed)))
 
 
 def init(coordinator=None, num_processes=None, process_id=None):
     """Bootstrap multi-process JAX (reference analog: tools/launch.py +
-    ps-lite rendezvous, kvstore_dist.h:51-53)."""
+    ps-lite rendezvous, kvstore_dist.h:51-53). Retries with backoff —
+    workers racing the coordinator's bind no longer die on attempt 1."""
     import jax
     if _initialized[0] or jax.process_count() > 1:
         _initialized[0] = True
@@ -43,20 +84,56 @@ def init(coordinator=None, num_processes=None, process_id=None):
         # single-process: nothing to bootstrap
         _initialized[0] = True
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=int(num_processes or
-                          os.environ.get("NUM_PROCESSES", 1)),
-        process_id=int(process_id or os.environ.get("PROCESS_ID", 0)))
+
+    def _do_init():
+        from .. import faultinject
+        if faultinject.fire("dist_init"):
+            raise faultinject.FaultInjected("dist_init")
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=int(num_processes or
+                              os.environ.get("NUM_PROCESSES", 1)),
+            process_id=int(process_id or os.environ.get("PROCESS_ID", 0)))
+
+    _retry(_do_init, "init")
     _initialized[0] = True
 
 
+def _kv_client():
+    """The jax coordination-service client (the process rendezvous that
+    ``jax.distributed.initialize`` already established) — the host-level
+    transport under the fallback collective and barrier."""
+    from jax._src import distributed
+    client = getattr(distributed.global_state, "client", None)
+    if client is None:
+        raise MXNetError(
+            "host-level fallback collective needs jax.distributed to be "
+            "initialized (no coordination-service client)")
+    return client
+
+
 def barrier():
-    """Global barrier (reference: ps Barrier, kvstore_dist.h:108)."""
+    """Global barrier (reference: ps Barrier, kvstore_dist.h:108). Uses
+    the XLA device barrier when the backend supports multi-process
+    computations; otherwise the coordination-service barrier (CPU
+    backend, degraded transport)."""
     import jax
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("mxnet_tpu_barrier")
+    if jax.process_count() <= 1:
+        return
+    _barrier_seq[0] += 1
+    seq = _barrier_seq[0]
+    if not _host_fallback[0]:
+        try:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"mxnet_tpu_barrier_{seq}")
+            return
+        except Exception as e:
+            if not _collective_unsupported(e):
+                raise
+            _note_fallback(e)
+    _, _, deadline = _ft_cfg()
+    _retry(lambda: _kv_client().wait_at_barrier(
+        f"mxtpu_b_{seq}", int(deadline * 1000)), "barrier")
 
 
 _reduce_cache = {}
@@ -99,18 +176,48 @@ def allreduce(array):
     return arrays[0]
 
 
+def _collective_unsupported(e):
+    """Does this error mean "the backend cannot run multi-process XLA
+    computations" (CPU backend, partial-fabric degradation) — i.e. the
+    host-level fallback applies — rather than a real program bug?"""
+    from .. import faultinject
+    if isinstance(e, faultinject.FaultInjected):
+        return True
+    msg = str(e)
+    return ("Multiprocess computations aren't implemented" in msg
+            or "not implemented on the CPU backend" in msg
+            or "UNIMPLEMENTED" in msg)
+
+
+def _note_fallback(e):
+    if not _host_fallback[0]:
+        _host_fallback[0] = True
+        fault.count("dist.collective_fallbacks")
+        warnings.warn(
+            "backend cannot run multi-process collectives "
+            f"({str(e).splitlines()[0][:120]}); degrading to the "
+            "host-level allgather-sum over the jax coordination service "
+            "— correct but slower (parallel/dist.py)")
+
+
 def allreduce_batch(arrays):
     """Sum a *list* of arrays across all processes with ONE device
     collective: everything is flattened into a single buffer, reduced as
     one XLA computation, and split back (reference analog: the server
     merging all keys of a push round, kvstore_dist_server.h:189 — but as a
-    batched allreduce instead of per-key RPCs)."""
+    batched allreduce instead of per-key RPCs).
+
+    When the backend can't run multi-process computations (the CPU
+    backend; injected transport faults), the SAME semantics degrade to a
+    host-level allgather-sum over the coordination-service KV store —
+    the job keeps training instead of hard-failing (sticky per process;
+    every process hits the identical backend limitation at the same
+    SPMD call, so the fleet degrades together).
+    """
     import jax
     import jax.numpy as jnp
     if jax.process_count() == 1:
         return list(arrays)
-    from jax.experimental import multihost_utils
-    from jax.sharding import PartitionSpec as P
 
     arrays = [jnp.asarray(a) for a in arrays]
     shapes = [a.shape for a in arrays]
@@ -118,17 +225,69 @@ def allreduce_batch(arrays):
     dtype = jnp.result_type(*arrays) if arrays else jnp.float32
     flat = jnp.concatenate([a.astype(dtype).ravel() for a in arrays]) \
         if arrays else jnp.zeros((0,), dtype)
+
+    if not _host_fallback[0]:
+        try:
+            summed = _allreduce_device(flat)
+        except Exception as e:
+            if not _collective_unsupported(e):
+                raise
+            _note_fallback(e)
+    if _host_fallback[0]:
+        summed = _allreduce_host_flat(np.asarray(flat))
+    out, pos = [], 0
+    for a, shape, size in zip(arrays, shapes, sizes):
+        out.append(jnp.asarray(summed[pos:pos + size]).reshape(shape)
+                   .astype(a.dtype))
+        pos += size
+    return out
+
+
+def _allreduce_device(flat):
+    """The XLA cross-process sum (one compiled collective over DCN/ICI)."""
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+    from .. import faultinject
+    if faultinject.fire("dist_drop"):
+        raise faultinject.FaultInjected("dist_drop")
     mesh = _global_mesh()
     global_buf = multihost_utils.host_local_array_to_global_array(
         flat[None], mesh, P("proc"))
     summed = _reduce_jit(mesh)(global_buf)
-    local = multihost_utils.global_array_to_host_local_array(
+    return multihost_utils.global_array_to_host_local_array(
         summed, mesh, P())
-    out, pos = [], 0
-    for a, shape, size in zip(arrays, shapes, sizes):
-        out.append(local[pos:pos + size].reshape(shape).astype(a.dtype))
-        pos += size
-    return out
+
+
+def _allreduce_host_flat(flat):
+    """Host-level allgather-sum of one flat numpy buffer through the
+    coordination-service KV store: publish local bytes, barrier, fetch
+    every rank's buffer, sum, barrier, clean up own key. O(n·procs)
+    traffic through the coordinator — the degraded-mode transport, not
+    the fast path."""
+    import jax
+    client = _kv_client()
+    _, _, deadline = _ft_cfg()
+    tmo = int(deadline * 1000)
+    rank = jax.process_index()
+    nproc = jax.process_count()
+    _host_seq[0] += 1
+    seq = _host_seq[0]
+    base = f"mxtpu_ar/{seq}"
+    client.key_value_set_bytes(f"{base}/{rank}",
+                               np.ascontiguousarray(flat).tobytes())
+    client.wait_at_barrier(f"{base}/ready", tmo)
+    total = np.zeros_like(flat)
+    for r in range(nproc):
+        raw = client.blocking_key_value_get_bytes(f"{base}/{r}", tmo)
+        total += np.frombuffer(raw, flat.dtype).reshape(flat.shape)
+    # every rank must have READ all buffers before anyone deletes
+    client.wait_at_barrier(f"{base}/done", tmo)
+    try:
+        client.key_value_delete(f"{base}/{rank}")
+    except Exception:
+        pass  # cleanup is best-effort; keys are seq-namespaced
+    fault.count("dist.host_collectives")
+    return total
 
 
 class DistKVStore(KVStore):

@@ -12,7 +12,8 @@ import threading
 import jax
 import numpy as np
 
-__all__ = ["seed", "next_key", "current_key", "numpy_rng"]
+__all__ = ["seed", "next_key", "current_key", "numpy_rng", "get_state",
+           "set_state"]
 
 _lock = threading.Lock()
 _key = [None]  # lazy: creating a key at import time would init the backend
@@ -103,3 +104,26 @@ def current_key():
         if _key[0] is None:
             _key[0] = np.array([0, 0], np.uint32)  # == PRNGKey(0)
         return _key[0]
+
+
+def get_state():
+    """Snapshot the full global RNG state (splitting key + host numpy
+    generator) as a picklable dict — what CheckpointManager persists so
+    auto-resumed runs draw the SAME stream a never-crashed run would."""
+    with _lock:
+        key = None if _key[0] is None else np.asarray(_key[0]).copy()
+        np_state = None if _np_rng[0] is None else _np_rng[0].get_state()
+        return {"key": key, "numpy": np_state,
+                "trace_fallback": _trace_fallback[0]}
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot."""
+    with _lock:
+        _key[0] = None if state.get("key") is None \
+            else np.asarray(state["key"], np.uint32)
+        if state.get("numpy") is not None:
+            if _np_rng[0] is None:
+                _np_rng[0] = np.random.RandomState(0)
+            _np_rng[0].set_state(state["numpy"])
+        _trace_fallback[0] = int(state.get("trace_fallback", 0))

@@ -6,10 +6,21 @@ hard worker failure mid-job (the reference's recovery story is the same:
 restart from the last checkpoint; tests/nightly has no in-job elastic
 rejoin, and neither does this framework — see docs/faq/failure_recovery.md).
 
-Usage: resume_worker.py <prefix> <num_epoch> [--crash-at K | --load-epoch K]
+Usage: resume_worker.py <prefix> <num_epoch>
+           [--crash-at K | --load-epoch K]
+           [--manager-dir D [--auto-resume]]
+
+Two checkpoint regimes:
+- legacy: per-epoch ``do_checkpoint`` files + ``--load-epoch`` (the
+  reference's recovery story), and
+- manager: ``CheckpointManager`` + ``fit(auto_resume=...)`` — full-state
+  atomic checkpoints; crashes come from the MXTPU_FAULT_INJECT env spec
+  the parent test arms (e.g. SIGKILL at byte N of a checkpoint write).
+
 Writes final train accuracy to <prefix>.acc on clean completion.
 """
 import argparse
+import logging
 import os
 import signal
 import sys
@@ -42,7 +53,13 @@ def main():
     ap.add_argument("num_epoch", type=int)
     ap.add_argument("--crash-at", type=int, default=None)
     ap.add_argument("--load-epoch", type=int, default=None)
+    ap.add_argument("--manager-dir", default=None)
+    ap.add_argument("--auto-resume", action="store_true")
     args = ap.parse_args()
+
+    # fit/CheckpointManager report resume + fallback decisions via
+    # logging; the parent test asserts on this process's stdout
+    logging.basicConfig(level=logging.INFO, stream=sys.stdout, force=True)
 
     from common.data import SyntheticDataIter
     mx.random.seed(0)
@@ -57,7 +74,12 @@ def main():
         begin_epoch = args.load_epoch
         print(f"Resume training from epoch {begin_epoch}", flush=True)
 
-    cbs = [mx.callback.do_checkpoint(args.prefix)]
+    manager = None
+    cbs = []
+    if args.manager_dir is not None:
+        manager = mx.CheckpointManager(args.manager_dir)
+    else:
+        cbs.append(mx.callback.do_checkpoint(args.prefix))
     if args.crash_at is not None:
         crash_at = args.crash_at
 
@@ -74,7 +96,8 @@ def main():
             initializer=mx.init.Xavier(), eval_metric="acc",
             arg_params=arg_params, aux_params=aux_params,
             begin_epoch=begin_epoch,
-            epoch_end_callback=cbs)
+            epoch_end_callback=cbs or None,
+            checkpoint_manager=manager, auto_resume=args.auto_resume)
 
     train.reset()
     acc = mod.score(train, "acc")[0][1]

@@ -21,7 +21,7 @@ _ROOT = os.path.dirname(_TESTS)
 
 _KNOWN = {
     # registered project markers
-    "slow", "serving",
+    "slow", "serving", "chaos",
     # pytest built-ins
     "parametrize", "skip", "skipif", "xfail", "usefixtures",
     "filterwarnings",
@@ -87,6 +87,36 @@ def test_serving_markers_are_registered_and_used():
     assert not unmarked, (
         f"{unmarked} must carry pytest.mark.serving so '-m serving' "
         "selects the whole subsystem")
+
+
+def test_chaos_suites_are_marked_and_stay_tier1():
+    """The fault-injection suites are tier-1's proof that a crash at any
+    byte of a checkpoint write can't lose the job and that a NaN step
+    can't poison the donated state. They must (a) carry the registered
+    ``chaos`` marker so ``-m chaos`` selects the subsystem, and (b)
+    never grow a ``slow`` mark that would silently drop them from the
+    ``-m 'not slow'`` gate."""
+    ini = os.path.join(_ROOT, "pytest.ini")
+    cp = configparser.ConfigParser()
+    cp.read(ini)
+    markers = cp.get("pytest", "markers", fallback="")
+    assert re.search(r"^\s*chaos\s*:", markers, re.M), \
+        "the 'chaos' marker must be registered in pytest.ini"
+    protected = {"test_checkpoint_manager.py", "test_ft_guard.py",
+                 "test_failure_resume.py"}
+    for name in protected:
+        assert os.path.exists(os.path.join(_TESTS, name)), \
+            f"chaos suite {name} missing"
+    uses = _mark_uses()
+    unmarked = protected - uses.get("chaos", set())
+    assert not unmarked, (
+        f"{unmarked} must carry pytest.mark.chaos (module-level "
+        "pytestmark) so '-m chaos' selects the fault-tolerance suites")
+    slow_marked = protected & uses.get("slow", set())
+    assert not slow_marked, (
+        f"{slow_marked} must not be marked slow: the fault-injection "
+        "cases are tier-1's only coverage of checkpoint atomicity and "
+        "the non-finite step guard")
 
 
 def test_serving_fast_paths_stay_in_tier1():
