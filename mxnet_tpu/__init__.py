@@ -64,6 +64,8 @@ from . import serving
 from .serving import serving_report
 from . import fault
 from .fault import fault_report
+from . import data
+from .data import data_report
 from . import faultinject
 from . import checkpoint
 from .checkpoint import CheckpointManager

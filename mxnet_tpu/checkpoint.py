@@ -78,6 +78,17 @@ class CheckpointState:
         return (f"CheckpointState(tag={self.tag}, epoch={self.epoch}, "
                 f"nbatch={self.nbatch}, path={self.path!r})")
 
+    @property
+    def data_state(self):
+        """The data-pipeline cursor saved with this checkpoint (the
+        ``get_state()`` dict of the train iterator / ``DataPipeline``),
+        or None. ``fit(auto_resume=True)`` feeds it back through
+        ``set_state`` so resume replays the exact remaining batch
+        stream — the data half of zero-retraining recovery."""
+        if isinstance(self.extra, dict):
+            return self.extra.get("data_state")
+        return None
+
 
 def _crc_file(path):
     crc = 0
@@ -127,12 +138,17 @@ class CheckpointManager:
 
     # -- save -----------------------------------------------------------------
     def save_module(self, module, epoch, nbatch=0, eval_metric=None,
-                    extra=None):
+                    extra=None, data_state=None):
         """Snapshot a bound+initialized Module into checkpoint ``epoch``
         (the tag doubles as the resume cursor: "next epoch to run").
         Device state is pulled to host HERE (``get_params`` syncs the
         fused donated buffers); with ``async_save`` the file writes then
-        happen on a background thread off those host copies."""
+        happen on a background thread off those host copies.
+        ``data_state`` (a train-iterator ``get_state()`` cursor) rides in
+        ``extra`` and resurfaces as ``CheckpointState.data_state``."""
+        if data_state is not None:
+            extra = dict(extra or {})
+            extra["data_state"] = data_state
         arg_params, aux_params = module.get_params()
         args_np = {k: v.asnumpy() for k, v in arg_params.items()}
         auxs_np = {k: v.asnumpy() for k, v in aux_params.items()}

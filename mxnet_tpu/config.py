@@ -140,6 +140,21 @@ register("MXTPU_FT_DIST_BACKOFF", 0.5, float,
 register("MXTPU_FT_DIST_DEADLINE", 120.0, float,
          "Total seconds budget across dist retries and the host-level "
          "fallback collective's blocking KV reads/barriers")
+register("MXTPU_DATA_PIPELINE", "auto", str,
+         "Async host data pipeline (data/pipeline.py) wrapped around "
+         "fit()'s train iterator: multi-worker decode, double-buffered "
+         "device staging, checkpointable cursor. 1/auto = on, 0 = off; "
+         "the batch stream is byte-identical either way")
+register("MXTPU_DATA_WORKERS", 2, int,
+         "Decode/augment worker threads per DataPipeline (the reference's "
+         "preprocess_threads analog for the pipeline subsystem)")
+register("MXTPU_DATA_QUEUE_DEPTH", 4, int,
+         "Bounded depth (batches) of the pipeline's work/done queues — "
+         "how far the source thread reads ahead of the workers")
+register("MXTPU_DATA_STAGE_AHEAD", 2, int,
+         "Staged-batch slots already device_put ahead of the consumer "
+         "(2 = classic double buffering: next batch on device before "
+         "the current step retires)")
 register("MXTPU_FAULT_INJECT", "", str,
          "Deterministic fault-injection spec, 'site:k=v[:k=v];site2:...' "
          "(faultinject.py) — e.g. 'ckpt_write:byte=100:action=kill', "

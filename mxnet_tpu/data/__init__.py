@@ -1,0 +1,17 @@
+"""``mxnet_tpu.data`` — the async host data pipeline subsystem.
+
+Replaces the reference's C++ ``src/io/`` layer (ThreadedIter prefetch +
+multithreaded RecordIO decode) with a Python-native pipeline over any
+``DataIter``: multi-worker decode into bounded queues, double-buffered
+``jax.device_put`` staging ahead of compute, per-host shard selection
+from the dist rank, and a checkpointable cursor so ``auto_resume``
+restores the data position bit-for-bit. ``mx.data_report()`` answers
+"are we input-bound?"; see ``docs/architecture.md`` "Data pipeline".
+"""
+from .pipeline import (DataPipeline, RecordIOSource, from_recordio,
+                       maybe_wrap_for_fit)
+from .report import data_report
+from . import workers
+
+__all__ = ["DataPipeline", "RecordIOSource", "from_recordio",
+           "maybe_wrap_for_fit", "data_report", "workers"]

@@ -1,0 +1,92 @@
+"""Input-pipeline observability: ``mx.data_report()``.
+
+The "are we input-bound?" answer. Every live :class:`~mxnet_tpu.data.
+DataPipeline` registers here (weakrefs, same pattern as ``fault.py`` /
+``serving_report``); the report aggregates per-stage queue depths, decode
+rate, and — the headline — the consumer's **step wait-time** and
+**starvation fraction**: how long and how often ``next()`` blocked because
+the host pipeline had no staged batch ready. A starving consumer means
+the job is input-bound and more workers / deeper queues (``MXTPU_DATA_*``)
+are the fix; ~zero wait means compute is the bottleneck and the pipeline
+is doing its job (SURVEY: "data pipeline must be async host-side").
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+__all__ = ["data_report", "register_pipeline"]
+
+_lock = threading.Lock()
+_pipelines = []     # weakrefs to live DataPipeline instances
+
+
+def register_pipeline(pipe):
+    with _lock:
+        _pipelines[:] = [wr for wr in _pipelines if wr() is not None]
+        _pipelines.append(weakref.ref(pipe))
+
+
+def _live():
+    with _lock:
+        return [p for p in (wr() for wr in _pipelines) if p is not None]
+
+
+_prof_counters = [None]
+
+
+def _mirror_prof(wait_s, starvation):
+    """Mirror the headline gauges into profiler ``data::`` counters so
+    traces/aggregates show them next to the ``data::source``/``decode``/
+    ``stage`` spans (same pattern as ``fault._update_prof_counter``)."""
+    try:
+        from .. import profiler
+        if _prof_counters[0] is None:
+            dom = profiler.Domain("data")
+            _prof_counters[0] = (dom.new_counter("wait_s"),
+                                 dom.new_counter("starvation_fraction"))
+        _prof_counters[0][0].set_value(round(wait_s, 6))
+        _prof_counters[0][1].set_value(round(starvation, 6))
+    except Exception:
+        pass
+
+
+def data_report(reset=False):
+    """Aggregate input-pipeline state across every live pipeline:
+
+    - ``wait_s`` / ``waits`` / ``starvation_fraction``: total seconds,
+      count, and fraction of ``next()`` calls that blocked on the host
+      pipeline (the input-bound signal; reading costs no device sync),
+    - ``decode_items_s``: items decoded per worker-busy-second,
+    - per-pipeline: stage queue depths, per-stage busy seconds, worker
+      count and queue/stage-ahead bounds.
+
+    ``reset=True`` zeroes the counters (cursors are untouched) for
+    windowed measurements.
+    """
+    pipes = _live()
+    per = {}
+    tot_wait = tot_waits = tot_calls = 0.0
+    tot_items = tot_busy = 0.0
+    for p in pipes:
+        s = p.stats(reset=reset)
+        name = s.pop("name")
+        if name in per:  # two pipelines with one name: keep both visible
+            name = f"{name}#{len(per)}"
+        per[name] = s
+        tot_wait += s["wait_s"]
+        tot_waits += s["waits"]
+        tot_calls += s["next_calls"]
+        tot_items += s["items_decoded"]
+        tot_busy += s["decode_busy_s"]
+    _mirror_prof(tot_wait, tot_waits / tot_calls if tot_calls else 0.0)
+    return {
+        "pipelines": per,
+        "wait_s": round(tot_wait, 6),
+        "waits": int(tot_waits),
+        "next_calls": int(tot_calls),
+        "starvation_fraction": round(tot_waits / tot_calls, 6)
+        if tot_calls else 0.0,
+        "decode_items_s": round(tot_items / tot_busy, 2)
+        if tot_busy > 0 else None,
+    }

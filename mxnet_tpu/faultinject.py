@@ -1,9 +1,14 @@
 """Deterministic fault-injection harness for the fault-tolerance layer.
 
 Faults are armed **by site and ordinal**, never randomly: a spec names a
-site (``ckpt_write``, ``nan_grad``, ``data_iter``, ``dist_drop``,
-``dist_init``, ``ckpt_truncate``) plus the exact coordinate at which it
-fires (byte offset, step index, batch index, call ordinal). The same spec
+site (``ckpt_write``, ``nan_grad``, ``data_iter``, ``data_worker``,
+``dist_drop``, ``dist_init``, ``ckpt_truncate``) plus the exact
+coordinate at which it fires (byte offset, step index, batch index, call
+ordinal). ``data_iter`` fires on the consumer thread at an iterator's
+B-th ``next()``; ``data_worker`` fires INSIDE a data-pipeline decode
+worker at the B-th produced batch (``data/pipeline.py``) — with
+``action=kill`` it is the dying-input-worker drill the chaos suite
+resumes from checkpoint. The same spec
 always produces the same failure, so CI chaos suites are reproducible
 bit-for-bit (contrast: the classic chaos-monkey coin flip, useless as a
 regression gate).

@@ -175,10 +175,46 @@ class ResizeIter(DataIter):
     def getpad(self):
         return self.current_batch.pad
 
+    # -- checkpointable cursor (data/pipeline.py protocol) ---------------------
+    def get_state(self):
+        """A ``cur`` counter alone cannot place the wrapped iterator, so
+        this refuses (loudly) to claim resume support the inner iterator
+        can't honor — callers that probe (fit's epoch-end save, the
+        pipeline's epoch snapshot) degrade gracefully."""
+        inner = getattr(self.data_iter, "get_state", None)
+        if not callable(inner):
+            raise NotImplementedError(
+                "ResizeIter cursor needs the wrapped iterator to support "
+                f"get_state(); {type(self.data_iter).__name__} does not")
+        return {"cur": int(self.cur), "inner": inner()}
+
+    def set_state(self, state):
+        if not isinstance(state, dict) or "cur" not in state or \
+                "inner" not in state:
+            raise ValueError(
+                "not a ResizeIter cursor (missing 'cur'/'inner'; got "
+                f"keys {sorted(state) if isinstance(state, dict) else state})")
+        setter = getattr(self.data_iter, "set_state", None)
+        if not callable(setter):
+            raise ValueError(
+                "ResizeIter cursor carries an inner-iterator state but "
+                f"{type(self.data_iter).__name__} has no set_state(); "
+                "refusing a resume that would silently replay from the "
+                "wrong position")
+        setter(state["inner"])
+        self.cur = int(state.get("cur", 0))
+
 
 class PrefetchingIter(DataIter):
     """Thread-based prefetcher over one or more iterators
-    (reference: io.py:349; native analog iter_prefetcher.h:142)."""
+    (reference: io.py:349; native analog iter_prefetcher.h:142).
+
+    Hardened shutdown path (shared with ``data.DataPipeline`` via
+    ``data/workers.py``): worker exceptions are captured and re-raised
+    at ``next()``/``reset()`` instead of silently truncating the epoch,
+    ``close()`` joins the prefetch threads (idempotent, also run from
+    ``__del__`` and the atexit registry), and a dead worker can never
+    hang the consumer on an event that would never fire."""
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         super().__init__()
@@ -197,6 +233,8 @@ class PrefetchingIter(DataIter):
         self.started = True
         self.current_batch = [None for _ in range(self.n_iter)]
         self.next_batch = [None for _ in range(self.n_iter)]
+        from .data import workers as _wk
+        self._group = _wk.WorkerGroup("prefetch")
 
         def prefetch_func(self, i):
             while True:
@@ -207,19 +245,39 @@ class PrefetchingIter(DataIter):
                     self.next_batch[i] = self.iters[i].next()
                 except StopIteration:
                     self.next_batch[i] = None
+                except BaseException as e:
+                    # surface at next(), don't fake an end-of-data; wake
+                    # the consumer before dying so it can't block forever
+                    self.next_batch[i] = None
+                    self._group.fail(e)
+                    self.data_taken[i].clear()
+                    self.data_ready[i].set()
+                    raise
                 self.data_taken[i].clear()
                 self.data_ready[i].set()
 
         self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
+            self._group.spawn(prefetch_func, self, i,
+                              name=f"prefetch-{i}")
             for i in range(self.n_iter)]
-        for thread in self.prefetch_threads:
-            thread.start()
+        _wk.register_closeable(self)
 
-    def __del__(self):
+    def close(self):
+        """Stop and JOIN the prefetch threads (they used to leak across
+        reset()/GC as parked daemons). Idempotent; registered atexit."""
+        if not self.started:
+            return
         self.started = False
+        self._group.stop()
         for e in self.data_taken:
             e.set()
+        self._group.join()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     @property
     def provide_data(self):
@@ -244,6 +302,7 @@ class PrefetchingIter(DataIter):
     def reset(self):
         for e in self.data_ready:
             e.wait()
+        self._group.raise_error()
         for i in self.iters:
             i.reset()
         for e in self.data_ready:
@@ -254,6 +313,9 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         for e in self.data_ready:
             e.wait()
+        # a worker exception ends the epoch HERE, loudly (it used to be
+        # swallowed into a silent StopIteration)
+        self._group.raise_error()
         if self.next_batch[0] is None:
             for i in self.next_batch:
                 assert i is None, "Number of entry mismatches between iterators"
@@ -338,6 +400,10 @@ class NDArrayIter(DataIter):
             self.label = [(k, v.asnumpy()[self.idx]) for k, v in self.label]
             self.data = [(k, nd.array(v)) for k, v in self.data]
             self.label = [(k, nd.array(v)) for k, v in self.label]
+        # the FULL physical-row permutation (idx gets truncated below for
+        # 'discard'; batches slice physical rows, so this is what the
+        # resume cursor must capture)
+        self._row_order = self.idx.copy()
         if last_batch_handle == "discard":
             new_n = self.data[0][1].shape[0] - \
                 self.data[0][1].shape[0] % batch_size
@@ -379,6 +445,12 @@ class NDArrayIter(DataIter):
         self.cursor += self.batch_size
         return self.cursor < self.num_data
 
+    def skip_batches(self, n):
+        """Fast-forward ``n`` batches without materializing them (same
+        cursor arithmetic as ``iter_next``) — lets the data pipeline's
+        checkpoint resume seek instead of replay-and-discard."""
+        self.cursor += int(n) * self.batch_size
+
     def next(self):
         if self.iter_next():
             return DataBatch(data=self.getdata(), label=self.getlabel(),
@@ -407,6 +479,55 @@ class NDArrayIter(DataIter):
                 self.cursor + self.batch_size > self.num_data:
             return self.cursor + self.batch_size - self.num_data
         return 0
+
+    # -- checkpointable cursor (data/pipeline.py protocol) ---------------------
+    def get_state(self):
+        """Resume cursor: position + the construction-time shuffle
+        permutation, so a fresh process (whose ambient RNG drew a
+        DIFFERENT permutation) restores the exact saved batch stream
+        through ``CheckpointManager``/``fit(auto_resume=True)``.
+        Unshuffled iterators store ``order=None`` (identity), keeping
+        the per-checkpoint cursor a few bytes instead of one int per
+        dataset row."""
+        n = len(self._row_order)
+        identity = np.array_equal(self._row_order, np.arange(n))
+        return {"cursor": int(self.cursor),
+                "order": None if identity
+                else np.asarray(self._row_order, np.int64),
+                "rows": int(n)}
+
+    def set_state(self, state):
+        if not isinstance(state, dict) or "cursor" not in state or \
+                "rows" not in state:
+            raise ValueError(
+                "not an NDArrayIter cursor (missing 'cursor'/'rows'; got "
+                f"keys {sorted(state) if isinstance(state, dict) else state}"
+                ") — was this checkpoint saved under a different "
+                "MXTPU_DATA_PIPELINE setting?")
+        n = len(self._row_order)
+        rows = int(state.get("rows", n))
+        if rows != n:
+            raise ValueError(
+                "NDArrayIter cursor was saved for a different dataset: "
+                f"saved order covers {rows} rows, this iterator holds {n}")
+        order = state.get("order")
+        order = np.arange(n) if order is None \
+            else np.asarray(order, np.int64)
+        if not np.array_equal(order, self._row_order):
+            # stored rows are base rows permuted by _row_order; map to
+            # the SAVED permutation: new[j] = base[order[j]]
+            inv = np.empty(n, np.int64)
+            inv[self._row_order] = np.arange(n)
+            take = inv[order]
+            self.data = [(k, nd.array(v.asnumpy()[take]))
+                         for k, v in self.data]
+            self.label = [(k, nd.array(v.asnumpy()[take]))
+                          for k, v in self.label]
+            self.data_list = [x[1] for x in self.data] + \
+                [x[1] for x in self.label]
+            self._row_order = order
+            self.idx = order[:len(self.idx)]
+        self.cursor = int(state.get("cursor", -self.batch_size))
 
 
 class MXDataIter(DataIter):

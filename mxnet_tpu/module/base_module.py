@@ -211,6 +211,15 @@ class BaseModule:
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
 
+        # async host data pipeline (MXTPU_DATA_PIPELINE, auto-on):
+        # read-ahead decode + double-buffered device staging around the
+        # train iterator; the batch stream is byte-identical to the
+        # unwrapped iterator (data/pipeline.py). The wrapper also gives
+        # any iterator the checkpointable-cursor protocol at the
+        # pipeline level.
+        from ..data import maybe_wrap_for_fit
+        train_data, _owned_pipe = maybe_wrap_for_fit(train_data, self)
+
         if checkpoint_manager is not None and auto_resume:
             resumed = checkpoint_manager.restore(self)
             if resumed is not None:
@@ -218,12 +227,65 @@ class BaseModule:
                 self.logger.info(
                     "Auto-resume from checkpoint '%s': continuing at "
                     "epoch %d", resumed.path, begin_epoch)
+                ds = resumed.data_state
+                if ds is not None and \
+                        callable(getattr(train_data, "set_state", None)):
+                    # restore the DATA position too: the saved cursor is
+                    # the end-of-epoch state from before the crash, so
+                    # replay the epoch-end reset() the killed run never
+                    # ran — the next epoch's stream matches an
+                    # uninterrupted job exactly
+                    try:
+                        train_data.set_state(ds)
+                        train_data.reset()
+                        self.logger.info(
+                            "Auto-resume restored the data cursor "
+                            "(epoch %s, batch %s)", ds.get("epoch"),
+                            ds.get("batch"))
+                    except (ValueError, NotImplementedError) as e:
+                        # cursor saved for a different iterator regime
+                        # (e.g. MXTPU_DATA_PIPELINE toggled between
+                        # save and resume): params still resume; the
+                        # data stream restarts from a fresh epoch —
+                        # loudly, never silently mis-applied
+                        self.logger.warning(
+                            "Auto-resume could not restore the data "
+                            "cursor (%s); the input stream restarts "
+                            "from a fresh epoch", e)
 
         if validation_metric is None:
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        try:
+            self._fit_loop(train_data, eval_data, eval_metric,
+                           validation_metric, epoch_end_callback,
+                           batch_end_callback, eval_end_callback,
+                           eval_batch_end_callback, monitor,
+                           sparse_row_id_fn, begin_epoch, num_epoch,
+                           checkpoint_manager)
+        finally:
+            if _owned_pipe is not None:
+                # fit created the pipeline: join its threads even when
+                # training dies mid-epoch (Ctrl-C, fault drills) so the
+                # process never hangs on a full queue
+                _owned_pipe.close()
+
+        if checkpoint_manager is not None:
+            # drain an in-flight async save before returning: the caller
+            # may exit immediately, and a daemon writer killed mid-write
+            # would leave the final checkpoint torn; this also re-raises
+            # any background save failure instead of swallowing it
+            checkpoint_manager.wait()
+
+    def _fit_loop(self, train_data, eval_data, eval_metric,
+                  validation_metric, epoch_end_callback, batch_end_callback,
+                  eval_end_callback, eval_batch_end_callback, monitor,
+                  sparse_row_id_fn, begin_epoch, num_epoch,
+                  checkpoint_manager):
+        """The per-epoch training loop body of :meth:`fit` (split out so
+        fit's pipeline/checkpoint lifecycle wraps it in one place)."""
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -274,10 +336,19 @@ class BaseModule:
 
             if checkpoint_manager is not None:
                 # tag epoch+1 == the next epoch to run: auto_resume picks
-                # it up as begin_epoch, so completed epochs never rerun
+                # it up as begin_epoch, so completed epochs never rerun.
+                # The train iterator's cursor rides along so resume also
+                # restores the DATA position (shuffle order, epoch,
+                # batch ordinal) — data/pipeline.py protocol
+                ds_fn = getattr(train_data, "get_state", None)
+                try:
+                    data_state = ds_fn() if callable(ds_fn) else None
+                except Exception:
+                    data_state = None
                 checkpoint_manager.save_module(self, epoch + 1,
                                                nbatch=nbatch,
-                                               eval_metric=eval_metric)
+                                               eval_metric=eval_metric,
+                                               data_state=data_state)
 
             if eval_data is not None:
                 res = self.score(eval_data, validation_metric,
@@ -288,13 +359,6 @@ class BaseModule:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                      name, val)
             train_data.reset()
-
-        if checkpoint_manager is not None:
-            # drain an in-flight async save before returning: the caller
-            # may exit immediately, and a daemon writer killed mid-write
-            # would leave the final checkpoint torn; this also re-raises
-            # any background save failure instead of swallowing it
-            checkpoint_manager.wait()
 
     # -- misc ------------------------------------------------------------------
     def get_input_grads(self, merge_multi_context=True):

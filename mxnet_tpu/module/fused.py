@@ -430,6 +430,17 @@ class FusedSymbolStep:
             self._step_jit = jax.jit(step_fn, donate_argnums=donate,
                                      **jit_kw)
 
+    def staging_sharding(self):
+        """Sharding for batch inputs (data + labels), for the host data
+        pipeline's stager: batches staged with THIS sharding make
+        step()'s own device_put a no-op, so the transfer fully overlaps
+        the previous step instead of landing on the dispatch path.
+        None on single-device binds (plain device_put suffices)."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P(self.data_axis))
+
     # -- run ------------------------------------------------------------------
     def _state_args(self):
         return (self._pvals, self._opt_state, self._flat_p,

@@ -30,7 +30,8 @@ from ..base import MXNetError
 from ..kvstore import KVStore
 from ..ndarray.ndarray import NDArray, _wrap
 
-__all__ = ["DistKVStore", "init", "barrier", "allreduce"]
+__all__ = ["DistKVStore", "init", "barrier", "allreduce", "rank",
+           "world_size"]
 
 _initialized = [False]
 _host_fallback = [False]    # sticky: backend lacks multiproc collectives
@@ -97,6 +98,28 @@ def init(coordinator=None, num_processes=None, process_id=None):
 
     _retry(_do_init, "init")
     _initialized[0] = True
+
+
+def rank():
+    """This process's index in the job (0 when single-process / before
+    the backend initializes). The per-host shard selector the data
+    pipeline's ``RecordIOSource`` defaults to (reference analog: the
+    ``part_index`` DMLC rank every C++ iterator took)."""
+    import jax
+    try:
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def world_size():
+    """Number of processes in the job (1 when single-process) — the
+    ``num_parts`` default for per-host input sharding."""
+    import jax
+    try:
+        return max(1, int(jax.process_count()))
+    except Exception:
+        return 1
 
 
 def _kv_client():

@@ -28,7 +28,8 @@ from collections import defaultdict
 from typing import Dict, List, Optional
 
 __all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
-           "state", "Domain", "Task", "Frame", "Event", "Counter", "Marker"]
+           "state", "counters", "Domain", "Task", "Frame", "Event",
+           "Counter", "Marker"]
 
 _config = {
     "filename": "profile.json",
@@ -300,24 +301,42 @@ class Event(_Span):
         super().__init__("event", name)
 
 
+_live_counters: Dict[str, float] = {}
+
+
+def counters():
+    """Last value of every live :class:`Counter`, keyed ``domain::name``
+    — how the subsystem gauges (``ft::skipped_steps``, ``data::wait_s``,
+    ``data::starvation_fraction``…) surface without a trace viewer."""
+    return dict(_live_counters)
+
+
 class Counter:
-    """Numeric counter (reference: profiler.py:330)."""
+    """Numeric counter (reference: profiler.py:330). Values are mirrored
+    into the process-wide :func:`counters` table."""
 
     def __init__(self, domain, name, value=None):
         self.domain = domain
         self.name = name
         self.value = 0
+        self._record()
         if value is not None:
             self.set_value(value)
 
+    def _record(self):
+        _live_counters[f"{self.domain}::{self.name}"] = self.value
+
     def set_value(self, value):
         self.value = value
+        self._record()
 
     def increment(self, delta=1):
         self.value += delta
+        self._record()
 
     def decrement(self, delta=1):
         self.value -= delta
+        self._record()
 
     def __iadd__(self, v):
         self.increment(v)

@@ -82,6 +82,12 @@ class MXRecordIO:
     def __del__(self):
         self.close()
 
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
     def __getstate__(self):
         """For pickling (multiprocess DataLoader workers)
         (reference: recordio.py:87)."""

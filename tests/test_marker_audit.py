@@ -119,6 +119,30 @@ def test_chaos_suites_are_marked_and_stay_tier1():
         "the non-finite step guard")
 
 
+def test_data_pipeline_suite_stays_tier1_with_chaos_marked():
+    """The data-pipeline suite is tier-1's only proof that the async
+    host pipeline is byte-identical to the unpipelined iterator and
+    that a worker death can't silently truncate an epoch. It must (a)
+    exist, (b) never carry a module-wide or per-case ``slow`` mark, and
+    (c) mark its fault-injection cases ``chaos`` so ``-m chaos``
+    selects the whole fault drill surface."""
+    path = os.path.join(_TESTS, "test_data_pipeline.py")
+    assert os.path.exists(path), "tests/test_data_pipeline.py missing"
+    with open(path) as f:
+        src = f.read()
+    m = re.search(r"^pytestmark\s*=.*$", src, re.M)
+    assert m is None or "slow" not in m.group(0), (
+        "test_data_pipeline.py must stay tier-1: a module-level slow "
+        "mark drops the pipeline's byte-identity pins from the gate")
+    uses = _mark_uses()
+    assert "test_data_pipeline.py" not in uses.get("slow", set()), (
+        "test_data_pipeline.py cases must not be slow-marked — the "
+        "overlap/starvation counters are tier-1 acceptance pins")
+    assert "test_data_pipeline.py" in uses.get("chaos", set()), (
+        "the pipeline SIGKILL/worker-death drills must carry "
+        "pytest.mark.chaos like the other fault-injection suites")
+
+
 def test_serving_fast_paths_stay_in_tier1():
     """Timing-SLO serving cases (throughput-efficiency pins) are
     ``slow``; everything functional — retrace pinning, shedding,
