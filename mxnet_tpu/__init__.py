@@ -67,6 +67,8 @@ from .fault import fault_report
 from . import data
 from .data import data_report
 from . import faultinject
+from . import compile  # noqa: A004 — package named for mxnet_tpu.compile
+from .compile import compile_report
 from . import checkpoint
 from .checkpoint import CheckpointManager
 from . import contrib

@@ -170,6 +170,16 @@ class CheckpointManager:
                                       "num_update", 0) or 0),
             "time": time.time(),
         }
+        try:
+            # compile-registry snapshot: what this job compiled vs
+            # loaded before the save. A resume reading the manifest can
+            # see whether its own warm start (fit(auto_resume=True)
+            # with MXTPU_COMPILE_CACHE_DIR populated — zero fresh
+            # compiles) matches what the crashed run paid for.
+            from . import compile as compile_mod
+            meta["compile"] = compile_mod.compile_report()["totals"]
+        except Exception:
+            pass
         sym = getattr(module, "_symbol", None)
         if sym is not None:
             try:  # once per job: symbol graph for file-level interop

@@ -1,0 +1,280 @@
+"""Persistent compiled-program cache (``MXTPU_COMPILE_CACHE_DIR``).
+
+One file per program, named by the key digest::
+
+    <dir>/<sha256-digest>.mxprog
+
+Entry layout (self-describing, CRC-guarded)::
+
+    b"MXPROG1\\n"                     magic
+    uint32 big-endian header length
+    header JSON   {version, digest, name, kind, fingerprint, crc32,
+                   payload_len, created, backend}
+    payload bytes (pickled (serialized_executable, in_tree, out_tree))
+
+Every write is atomic (``base.atomic_write``: temp + fsync + rename), so
+a process killed at any byte never tears an existing entry. On read the
+entry is rejected — loudly, with a warning and a counter, never with a
+wrong program — when the magic/header don't parse (``corrupt``), the
+payload CRC32 or length disagree with the header (``corrupt``: bit rot,
+truncation, torn storage below the rename), or the stored version
+fingerprint differs from the running stack (``stale``: a jax / jaxlib /
+mxnet_tpu upgrade). A rejected entry is overwritten in place by the
+fresh compile that replaces it.
+
+Fault injection: the ``compile_cache`` site covers both failure shapes —
+``compile_cache:byte=N[:action=kill]`` arms a byte-budgeted write fault
+(via the :func:`base.atomic_write` ``guarded_write`` hook), and
+``compile_cache:bytes=N`` truncates the entry AFTER the rename commits
+(storage lying below the rename), which the CRC must catch on load.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import time
+import zlib
+
+from ..base import MXNetError, atomic_write
+
+__all__ = ["PersistentCache", "CacheEntryError", "default_cache",
+           "cache_enabled"]
+
+_MAGIC = b"MXPROG1\n"
+_SUFFIX = ".mxprog"
+
+
+class CacheEntryError(MXNetError):
+    """A cache entry exists but must not be used. ``reason`` is
+    ``"corrupt"`` (magic/CRC/length mismatch) or ``"stale"`` (version
+    fingerprint mismatch)."""
+
+    def __init__(self, path, reason, detail=""):
+        super().__init__(
+            f"compile-cache entry '{os.path.basename(path)}' is {reason}"
+            f"{': ' + detail if detail else ''}; falling back to a fresh "
+            "compile (the entry will be overwritten)")
+        self.path = path
+        self.reason = reason
+
+
+class PersistentCache:
+    """See module docstring. Construct with an explicit directory, or
+    use :func:`default_cache` for the ``MXTPU_COMPILE_CACHE_DIR`` one."""
+
+    def __init__(self, directory):
+        self.directory = os.fspath(directory)
+
+    @property
+    def enabled(self):
+        return bool(self.directory)
+
+    def path_for(self, digest):
+        return os.path.join(self.directory, digest + _SUFFIX)
+
+    # -- write ----------------------------------------------------------------
+    def put(self, key, payload, fingerprint=None):
+        """Atomically write one entry. ``payload`` is the pickled
+        serialized-executable blob; ``key`` a ProgramKey. Returns the
+        entry path. ``fingerprint`` is overridable for tests only."""
+        from . import key as key_mod
+        from .. import faultinject
+        os.makedirs(self.directory, exist_ok=True)
+        header = {
+            "version": key_mod.FORMAT_VERSION,
+            "digest": key.digest,
+            "name": key.name,
+            "kind": key.kind,
+            "fingerprint": fingerprint or key_mod.fingerprint(),
+            "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+            "payload_len": len(payload),
+            "created": time.time(),
+            "backend": key.materials.get("backend"),
+        }
+        hdr = json.dumps(header, sort_keys=True).encode("utf-8")
+        path = self.path_for(key.digest)
+        # the byte-budget fault site rides atomic_write's guarded_write
+        # hook, which arms on the 'ckpt_write' site by default — consult
+        # the compile_cache site here and re-arm the generic hook
+        with atomic_write(path) as f:
+            f = faultinject.guarded_write(f, path=path,
+                                          site="compile_cache")
+            f.write(_MAGIC)
+            f.write(struct.pack(">I", len(hdr)))
+            f.write(hdr)
+            f.write(payload)
+        # post-commit tearing (lying storage below the rename): the CRC
+        # recorded in the header is what must catch it on load
+        faultinject.maybe_truncate(path, site="compile_cache")
+        return path
+
+    # -- read -----------------------------------------------------------------
+    def read_header(self, path):
+        """Parse one entry's header; raises CacheEntryError("corrupt")
+        when the magic/header don't parse."""
+        try:
+            with open(path, "rb") as f:
+                if f.read(len(_MAGIC)) != _MAGIC:
+                    raise CacheEntryError(path, "corrupt", "bad magic")
+                (hlen,) = struct.unpack(">I", f.read(4))
+                if hlen <= 0 or hlen > (1 << 20):
+                    raise CacheEntryError(path, "corrupt",
+                                          "implausible header length")
+                return json.loads(f.read(hlen).decode("utf-8"))
+        except CacheEntryError:
+            raise
+        except (OSError, ValueError, struct.error,
+                UnicodeDecodeError) as e:
+            raise CacheEntryError(path, "corrupt", str(e))
+
+    def get(self, digest):
+        """Return the payload bytes for ``digest`` after full
+        validation, or None when there is no entry. Raises
+        :class:`CacheEntryError` on a corrupt or version-stale entry —
+        the caller falls back to a fresh compile and overwrites.
+
+        One open, one sequential read: a concurrent overwrite of the
+        entry (shared cache volume; atomic_write renames a fresh file
+        into place) can never mix the old header with the new payload.
+        """
+        from . import key as key_mod
+        path = self.path_for(digest)
+        try:
+            with open(path, "rb") as f:
+                if f.read(len(_MAGIC)) != _MAGIC:
+                    raise CacheEntryError(path, "corrupt", "bad magic")
+                (hlen,) = struct.unpack(">I", f.read(4))
+                if hlen <= 0 or hlen > (1 << 20):
+                    raise CacheEntryError(path, "corrupt",
+                                          "implausible header length")
+                header = json.loads(f.read(hlen).decode("utf-8"))
+                payload = f.read()
+        except FileNotFoundError:
+            return None
+        except CacheEntryError:
+            raise
+        except (OSError, ValueError, struct.error,
+                UnicodeDecodeError) as e:
+            raise CacheEntryError(path, "corrupt", str(e))
+        if header.get("fingerprint") != key_mod.fingerprint():
+            raise CacheEntryError(
+                path, "stale",
+                f"built by {header.get('fingerprint')!r}, running "
+                f"{key_mod.fingerprint()!r}")
+        if len(payload) != header.get("payload_len") or \
+                (zlib.crc32(payload) & 0xFFFFFFFF) != header.get("crc32"):
+            raise CacheEntryError(
+                path, "corrupt",
+                f"payload CRC/length mismatch ({len(payload)} bytes)")
+        return payload
+
+    # -- maintenance (tools/compile_cache.py) ---------------------------------
+    def entries(self):
+        """[(path, header-or-CacheEntryError)] for every entry file,
+        newest first."""
+        if not os.path.isdir(self.directory):
+            return []
+        out = []
+        for name in os.listdir(self.directory):
+            if not name.endswith(_SUFFIX):
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                out.append((path, self.read_header(path)))
+            except CacheEntryError as e:
+                out.append((path, e))
+        out.sort(key=lambda pe: -os.path.getmtime(pe[0]))
+        return out
+
+    def verify(self):
+        """Fully validate every entry (header + fingerprint + CRC).
+        Returns (ok_count, [(path, reason), ...] for the bad ones)."""
+        ok, bad = 0, []
+        for path, header in self.entries():
+            if isinstance(header, CacheEntryError):
+                bad.append((path, header.reason))
+                continue
+            try:
+                self.get(header["digest"])
+                ok += 1
+            except CacheEntryError as e:
+                bad.append((path, e.reason))
+        return ok, bad
+
+    def prune(self, max_age_s=None, max_bytes=None, remove_invalid=True):
+        """Retention: drop entries older than ``max_age_s``, then drop
+        oldest-first until total size fits ``max_bytes``; invalid
+        entries always go first. Returns [(path, why)] removed."""
+        removed = []
+        entries = self.entries()
+        now = time.time()
+        live = []
+        for path, header in entries:
+            if isinstance(header, CacheEntryError):
+                if remove_invalid:
+                    removed.append((path, header.reason))
+                    continue
+                header = {}
+            age = now - float(header.get("created") or
+                              os.path.getmtime(path))
+            if max_age_s is not None and max_age_s > 0 and age > max_age_s:
+                removed.append((path, f"age {age / 86400.0:.1f}d"))
+                continue
+            live.append((path, os.path.getsize(path)))
+        if max_bytes is not None and max_bytes > 0:
+            total = sum(s for _, s in live)
+            # live is newest-first: evict from the tail (oldest)
+            while total > max_bytes and live:
+                path, size = live.pop()
+                total -= size
+                removed.append((path, "size budget"))
+        for path, _why in removed:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        return removed
+
+
+_jax_cache_wired = [False]
+
+
+def _maybe_wire_jax_cache(directory):
+    """Point JAX's own persistent compilation cache at ``<dir>/xla`` —
+    a second, backend-level layer that caches the XLA optimization
+    output on TPU/GPU (jax skips it on CPU). Our ``.mxprog`` entries
+    remain the primary layer: they skip tracing AND compilation."""
+    if _jax_cache_wired[0]:
+        return
+    _jax_cache_wired[0] = True
+    from .. import config
+    if not config.get("MXTPU_COMPILE_JAX_CACHE"):
+        return
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(directory, "xla"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception:
+        pass
+
+
+def cache_enabled():
+    """Resolve MXTPU_COMPILE_CACHE / MXTPU_COMPILE_CACHE_DIR: on when a
+    directory is configured and the switch isn't 0/off."""
+    from .. import config
+    if not str(config.get("MXTPU_COMPILE_CACHE_DIR") or ""):
+        return False
+    return str(config.get("MXTPU_COMPILE_CACHE")).lower() not in \
+        ("0", "false", "off")
+
+
+def default_cache():
+    """The env-configured cache, or None when disabled."""
+    from .. import config
+    if not cache_enabled():
+        return None
+    directory = str(config.get("MXTPU_COMPILE_CACHE_DIR"))
+    _maybe_wire_jax_cache(directory)
+    return PersistentCache(directory)

@@ -1,0 +1,440 @@
+"""Program registry: compile observability + AOT load-or-compile.
+
+Every jitted entry point in the framework registers its programs here
+under a canonical :class:`~.key.ProgramKey`:
+
+- ``FusedSymbolStep`` (module/fused.py) and ``serving.Predictor``
+  route their compiles through :func:`load_or_compile` — full AOT: a
+  populated persistent cache turns a cold start's XLA compile storm
+  into file loads (``deserialize_and_load``), skipping tracing AND
+  compilation.
+- ``Executor`` (executor.py) routes its forward / forward+grad jits
+  through :func:`shared_programs` + :class:`JitProgram` — identical
+  program keys (e.g. two BucketingModule buckets with identical
+  shapes) share ONE jitted callable, traces are counted at trace time,
+  and first-call wall time is attributed as compile time.
+
+``compile_report()`` (exported as ``mx.compile_report``) is the one
+observability surface: per-program compile wall time, cache
+hit/miss/error counters, and the retrace guard — per entry point, how
+many times it recompiled and the diverging argument signature (or key
+material) that caused it. Compile/load/serialize work runs inside
+``compile::`` profiler spans so cold-start cost shows up in
+``mx.profiler`` dumps next to the ``serving::``/``ft::`` domains.
+"""
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+import time
+import weakref
+
+from .cache import CacheEntryError, default_cache
+from .key import arg_signature  # noqa: F401  (re-export for callers)
+
+__all__ = ["ProgramRecord", "load_or_compile", "shared_programs",
+           "JitProgram", "guarded_loaded_program", "note_entry_point",
+           "get_record", "compile_report", "donation_supported", "reset"]
+
+logger = logging.getLogger("mxnet_tpu.compile")
+
+_lock = threading.Lock()
+_records = {}            # digest -> ProgramRecord
+_entry_points = {}       # name -> (ProgramKey, arg_sig)
+_retraces = {}           # name -> {"count": int, "events": [...]}
+_shared = weakref.WeakValueDictionary()   # digest -> live shared holder
+_MAX_RETRACE_EVENTS = 8
+
+
+class ProgramRecord:
+    """Counters for one canonical program (one key digest)."""
+
+    __slots__ = ("name", "kind", "digest", "compiles", "cache_hits",
+                 "cache_misses", "cache_errors", "compile_s", "load_s",
+                 "serialize_s", "serialized", "arg_sig", "source")
+
+    def __init__(self, key):
+        self.name = key.name
+        self.kind = key.kind
+        self.digest = key.digest
+        self.compiles = 0        # fresh XLA compiles (traces taken)
+        self.cache_hits = 0      # AOT executables loaded from disk
+        self.cache_misses = 0    # cache enabled but no entry yet
+        self.cache_errors = 0    # corrupt/stale entries rejected
+        self.compile_s = 0.0
+        self.load_s = 0.0
+        self.serialize_s = 0.0
+        self.serialized = False  # an entry for this digest was written
+        self.arg_sig = None
+        self.source = None       # "compile" | "cache" (last acquisition)
+
+    def as_dict(self):
+        return {
+            "name": self.name, "kind": self.kind,
+            "digest": self.digest[:10],
+            "compiles": self.compiles, "cache_hits": self.cache_hits,
+            "cache_misses": self.cache_misses,
+            "cache_errors": self.cache_errors,
+            "compile_s": round(self.compile_s, 4),
+            "load_s": round(self.load_s, 4),
+            "serialized": self.serialized,
+            "source": self.source,
+        }
+
+
+def get_record(key_or_digest):
+    digest = getattr(key_or_digest, "digest", key_or_digest)
+    with _lock:
+        return _records.get(digest)
+
+
+def _ensure(key):
+    with _lock:
+        rec = _records.get(key.digest)
+        if rec is None:
+            rec = _records[key.digest] = ProgramRecord(key)
+        return rec
+
+
+def _restore_record(rec):
+    """Re-attach a live record after a ``reset()`` evicted it (long-
+    lived JitPrograms keep counting across report windows): the current
+    registry entry wins; an evicted record re-registers itself."""
+    with _lock:
+        cur = _records.get(rec.digest)
+        if cur is not None:
+            return cur
+        _records[rec.digest] = rec
+        return rec
+
+
+def _span(name):
+    from .. import profiler
+    return profiler.Domain("compile").new_task(name)
+
+
+def _count(name, delta=1):
+    try:
+        from .. import fault
+        fault.count(name, delta)
+    except Exception:
+        pass
+
+
+def note_entry_point(name, key, sig=None):
+    """Retrace guard: one entry point (a fused step, a predictor, an
+    executor) acquiring a program under a NEW key or argument signature
+    after it already held one is a retrace — record how many and what
+    diverged (the ISSUE-facing 'why did this recompile' answer)."""
+    with _lock:
+        prev = _entry_points.get(name)
+        _entry_points[name] = (key, sig)
+        if prev is None:
+            return
+        prev_key, prev_sig = prev
+        if prev_key.digest == key.digest and prev_sig == sig:
+            return
+        ent = _retraces.setdefault(name, {"count": 0, "events": []})
+        ent["count"] += 1
+        if len(ent["events"]) < _MAX_RETRACE_EVENTS:
+            ent["events"].append({
+                "changed": key.diff(prev_key),
+                "from_sig": _sig_summary(prev_sig),
+                "to_sig": _sig_summary(sig),
+            })
+
+
+def _sig_summary(sig, limit=6):
+    if sig is None:
+        return None
+    sig = list(sig)
+    body = [f"{tuple(s)}:{d}" for s, d in sig[:limit]]
+    if len(sig) > limit:
+        body.append(f"...+{len(sig) - limit}")
+    return body
+
+
+def donation_supported(backend=None):
+    """Whether the backend implements buffer donation. The CPU backend
+    does not and warns per compile — the one place that policy lives
+    (serving used to carry a local workaround; bench proxies inherit
+    this too)."""
+    if backend is None:
+        import jax
+        backend = jax.default_backend()
+    return backend != "cpu"
+
+
+# ---------------------------------------------------------------------------
+# AOT path: FusedSymbolStep / Predictor
+# ---------------------------------------------------------------------------
+def load_or_compile(key, lower, cache=None):
+    """Acquire the compiled executable for ``key``.
+
+    ``lower`` is a thunk returning the ``jax.stages.Lowered`` for the
+    program (called only on a cache miss). Returns ``(executable,
+    source)`` with source ``"cache"`` (AOT-deserialized, zero fresh
+    compiles) or ``"compile"`` (fresh trace+compile; the executable is
+    then serialized back into the cache best-effort).
+
+    A corrupt or version-stale entry is rejected LOUDLY — warning log,
+    ``cache_errors`` counter, ``compile.cache_corrupt``/``_stale``
+    fault counters — and falls back to the fresh compile, which
+    overwrites the bad entry. It can never produce a wrong program: the
+    digest pins every trace input and the CRC pins the bytes.
+    """
+    rec = _ensure(key)
+    if cache is None:
+        cache = default_cache()
+    payload = None
+    if cache is not None:
+        try:
+            payload = cache.get(key.digest)
+            if payload is None:
+                rec.cache_misses += 1
+        except CacheEntryError as e:
+            rec.cache_errors += 1
+            _count(f"compile.cache_{e.reason}")
+            logger.warning("%s", e)
+            payload = None
+    if payload is not None:
+        try:
+            from jax.experimental import serialize_executable
+            t0 = time.perf_counter()
+            with _span("load"):
+                blob, in_tree, out_tree = pickle.loads(payload)
+                exe = serialize_executable.deserialize_and_load(
+                    blob, in_tree, out_tree)
+            rec.load_s += time.perf_counter() - t0
+            rec.cache_hits += 1
+            rec.source = "cache"
+            _count("compile.cache_hits")
+            _refresh_prof_counters()
+            return exe, "cache"
+        except Exception as e:
+            # an entry that validated but won't deserialize (e.g. a
+            # pickle from an incompatible stack that slipped the
+            # fingerprint) — same loud fallback as corruption
+            rec.cache_errors += 1
+            _count("compile.cache_deserialize_errors")
+            logger.warning(
+                "compile-cache entry %s failed to deserialize (%s); "
+                "falling back to a fresh compile", key.short, e)
+    t0 = time.perf_counter()
+    with _span("compile"):
+        lowered = lower()
+        exe = lowered.compile()
+    rec.compile_s += time.perf_counter() - t0
+    rec.compiles += 1
+    rec.source = "compile"
+    _count("compile.fresh_compiles")
+    if cache is not None:
+        t0 = time.perf_counter()
+        try:
+            from jax.experimental import serialize_executable
+            with _span("serialize"):
+                blob, in_tree, out_tree = \
+                    serialize_executable.serialize(exe)
+                cache.put(key, pickle.dumps((blob, in_tree, out_tree)))
+            rec.serialized = True
+        except Exception as e:
+            # backends without executable serialization (or unpicklable
+            # shardings): the program still runs, it just isn't AOT
+            # reusable — record why, don't fail the step
+            _count("compile.serialize_unsupported")
+            logger.debug("compile-cache serialize skipped for %s: %s",
+                         key.short, e)
+        rec.serialize_s += time.perf_counter() - t0
+    _refresh_prof_counters()
+    return exe, "compile"
+
+
+def guarded_loaded_program(exe, fallback, what, on_reject=None):
+    """Wrap a cache-loaded executable so its FIRST call is guarded: an
+    aval/layout mismatch the key failed to anticipate degrades to the
+    ``fallback`` jit (a fresh in-process compile) with a warning and a
+    counter — never a broken step. Argument checking happens before
+    execution, so no donated buffer is consumed by the failed attempt.
+    Once one call succeeds the guard is dropped. ``on_reject`` lets the
+    caller repoint its program table at the fallback."""
+    state = {"proven": False}
+
+    def call(*args):
+        if state["proven"]:
+            return exe(*args)
+        try:
+            out = exe(*args)
+            state["proven"] = True
+            return out
+        except Exception as err:
+            logger.warning(
+                "cache-loaded %s executable rejected at call time (%s); "
+                "recompiling fresh", what, err)
+            _count("compile.load_call_fallback")
+            if on_reject is not None:
+                on_reject()
+            return fallback(*args)
+
+    return call
+
+
+# ---------------------------------------------------------------------------
+# shared-jit path: Executor
+# ---------------------------------------------------------------------------
+class SharedPrograms:
+    """Weakly-shared holder of an executor's jitted callables. Live
+    executors with the same program key hold the same instance, so
+    identical binds (two buckets with identical shapes) share one XLA
+    program; when the last executor dies the programs are collectable."""
+
+    def __init__(self, programs):
+        self.programs = programs
+
+    def __getitem__(self, name):
+        return self.programs[name]
+
+
+def shared_programs(key, builder):
+    """Memoize ``builder()`` (a dict of jitted callables) on the key
+    digest, weakly. Returns (SharedPrograms, was_shared)."""
+    with _lock:
+        holder = _shared.get(key.digest)
+        if holder is not None:
+            return holder, True
+    built = builder()
+    holder = SharedPrograms(built)
+    with _lock:
+        # a racing builder may have landed first — prefer the shared one
+        existing = _shared.get(key.digest)
+        if existing is not None:
+            return existing, True
+        _shared[key.digest] = holder
+    return holder, False
+
+
+class JitProgram:
+    """Registry-aware wrapper around one ``jax.jit`` callable.
+
+    Counts traces at trace time (a probe in the wrapped body runs only
+    while tracing — the steady-state call adds two perf_counter reads
+    and nothing else), attributes the wall time of any call that traced
+    as compile time, and feeds the retrace guard with the argument
+    signature that diverged. Used by Executor, where programs stay
+    shape-polymorphic jits (eval/train static args, optional head
+    grads) rather than AOT executables.
+    """
+
+    def __init__(self, fn, key, **jit_kwargs):
+        import jax
+        self.key = key
+        self.rec = _ensure(key)
+
+        def probed(*args, **kwargs):
+            # runs at trace time only; re-attach the record in case a
+            # compile_report(reset=True) window evicted it — a trace
+            # after the reset must still be visible in the report
+            rec = self.rec = _restore_record(self.rec)
+            rec.compiles += 1
+            _count("compile.fresh_compiles")
+            return fn(*args, **kwargs)
+
+        self._jfn = jax.jit(probed, **jit_kwargs)
+
+    def __call__(self, *args):
+        before_rec = self.rec
+        before = before_rec.compiles
+        t0 = time.perf_counter()
+        out = self._jfn(*args)
+        rec = self.rec       # the probe may have swapped the record
+        if rec is not before_rec or rec.compiles != before:
+            rec.compile_s += time.perf_counter() - t0
+            rec.source = "compile"
+            sig = arg_signature(args)
+            note_entry_point(rec.name, self.key, sig)
+            rec.arg_sig = sig
+            _refresh_prof_counters()
+        return out
+
+    def lower(self, *args, **kwargs):
+        return self._jfn.lower(*args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+_prof_counters = [None]
+
+
+def _refresh_prof_counters():
+    """Mirror the registry totals into ``compile::`` profiler counters
+    (profiler.counters()) so live jobs expose them without a report."""
+    try:
+        from .. import profiler
+        if _prof_counters[0] is None:
+            dom = profiler.Domain("compile")
+            _prof_counters[0] = {
+                "fresh_compiles": profiler.Counter(dom, "fresh_compiles"),
+                "cache_hits": profiler.Counter(dom, "cache_hits"),
+            }
+        with _lock:
+            fresh = sum(r.compiles for r in _records.values())
+            hits = sum(r.cache_hits for r in _records.values())
+        _prof_counters[0]["fresh_compiles"].set_value(fresh)
+        _prof_counters[0]["cache_hits"].set_value(hits)
+    except Exception:
+        pass
+
+
+def compile_report(reset=False):
+    """Aggregate compile observability (``mx.compile_report()``):
+
+    - ``programs``: one row per canonical program — fresh compiles,
+      cache hits/misses/rejections, compile + AOT-load wall seconds;
+    - ``retraces``: per entry point, recompile count with the diverging
+      argument signature / key material that caused each;
+    - ``totals``: summed counters (the subprocess warm-start tests pin
+      ``fresh_compiles == 0`` on these);
+    - ``cache``: the persistent-cache configuration in effect.
+    """
+    from .cache import cache_enabled
+    from .. import config
+    with _lock:
+        programs = [r.as_dict() for r in _records.values()]
+        retraces = {n: {"count": e["count"],
+                        "events": list(e["events"])}
+                    for n, e in _retraces.items()}
+    totals = {
+        "programs": len(programs),
+        "fresh_compiles": sum(p["compiles"] for p in programs),
+        "cache_hits": sum(p["cache_hits"] for p in programs),
+        "cache_misses": sum(p["cache_misses"] for p in programs),
+        "cache_errors": sum(p["cache_errors"] for p in programs),
+        "compile_s": round(sum(p["compile_s"] for p in programs), 4),
+        "load_s": round(sum(p["load_s"] for p in programs), 4),
+        "retraces": sum(e["count"] for e in retraces.values()),
+    }
+    out = {
+        "programs": sorted(programs, key=lambda p: -p["compile_s"]),
+        "retraces": retraces,
+        "totals": totals,
+        "cache": {
+            "enabled": cache_enabled(),
+            "dir": str(config.get("MXTPU_COMPILE_CACHE_DIR") or "") or
+            None,
+        },
+    }
+    if reset:
+        globals()["reset"]()
+    return out
+
+
+def reset():
+    """Clear every record/retrace counter (between measurement windows
+    or test cases). Live programs keep running; their records recreate
+    on the next acquisition."""
+    with _lock:
+        _records.clear()
+        _entry_points.clear()
+        _retraces.clear()
+    _refresh_prof_counters()

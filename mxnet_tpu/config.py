@@ -159,6 +159,25 @@ register("MXTPU_FAULT_INJECT", "", str,
          "Deterministic fault-injection spec, 'site:k=v[:k=v];site2:...' "
          "(faultinject.py) — e.g. 'ckpt_write:byte=100:action=kill', "
          "'nan_grad:step=3'. Empty = no faults. Test-only")
+register("MXTPU_COMPILE_CACHE_DIR", "", str,
+         "Persistent compiled-program cache directory (compile/): "
+         "fused train steps and Predictor buckets serialize their XLA "
+         "executables here so a restart loads programs instead of "
+         "recompiling. Empty = disabled")
+register("MXTPU_COMPILE_CACHE", "auto", str,
+         "Compile-cache master switch: 1/auto = on when CACHE_DIR is "
+         "set, 0 = off (the compile registry / mx.compile_report() "
+         "observability stays on either way)")
+register("MXTPU_COMPILE_CACHE_MAX_BYTES", 0, int,
+         "Compile-cache size budget for tools/compile_cache.py prune "
+         "(oldest entries evicted first); 0 = unlimited")
+register("MXTPU_COMPILE_CACHE_MAX_AGE_DAYS", 0.0, float,
+         "Compile-cache retention age for tools/compile_cache.py prune; "
+         "0 = keep forever")
+register("MXTPU_COMPILE_JAX_CACHE", True, bool,
+         "Also point JAX's own persistent compilation cache at "
+         "CACHE_DIR/xla (a second, backend-level layer on TPU/GPU; "
+         "the .mxprog entries remain the primary AOT layer)")
 
 
 def _autostart_profiler():

@@ -280,6 +280,7 @@ class Executor:
         # Multi-context (mesh) binds skip the pass: GSPMD cannot
         # partition through the opaque Pallas custom call.
         sym = self._symbol
+        infer_only = all(r == "null" for r in self.grad_req.values())
         if self._mesh is None:
             from .symbol.fusion import maybe_fuse
             shapes = {n: tuple(a.shape) for n, a in
@@ -288,17 +289,58 @@ class Executor:
             # inference-only binds (grad_req all 'null' — predict/score
             # and serving executors) report under their own tag so
             # fusion_report() shows the predict program is covered too
-            infer_only = all(r == "null" for r in self.grad_req.values())
             fused_sym, self._fusion_report = maybe_fuse(
                 self._symbol, shapes,
                 tag="executor_infer" if infer_only else "executor")
             if fused_sym is not None:
                 sym = fused_sym
-        fwd, fwd_loss, loss_specs = build_graph_fns(sym)
-        self._loss_specs = loss_specs
-        self._fwd_jit = jax.jit(fwd, static_argnums=(3,))
-        self._fwd_loss_grad = jax.jit(jax.grad(fwd_loss, argnums=0,
-                                               has_aux=True))
+        # route the bind through the compile registry: programs are
+        # keyed by (symbol JSON, bound shapes/dtypes, grad_req, mesh,
+        # fusion flag) and SHARED between executors with identical keys
+        # — two BucketingModule buckets binding identical shapes run
+        # one compiled program, and re-switching buckets never
+        # recompiles (compiles == unique program keys, pinned in
+        # tests/test_bucketing_lm.py). JitProgram counts traces and
+        # compile wall time into mx.compile_report().
+        from . import compile as compile_mod
+        from . import config as _config
+        sigs = sorted(
+            (n, tuple(a.shape), str(a.dtype))
+            for n, a in list(self.arg_dict.items()) +
+            list(self.aux_dict.items()))
+        fusion_mat = {
+            "flag": str(_config.get("MXTPU_PALLAS_FUSION")),
+            "sites": len(self._fusion_report["sites"])
+            if self._fusion_report else 0}
+        kind = "executor_infer" if infer_only else "executor"
+        base = f"executor:{self._symbol.name}"
+        grad_req_mat = sorted(self.grad_req.items())
+        symbol_sha = compile_mod.symbol_digest(self._symbol)
+
+        def _key(prog):
+            return compile_mod.program_key(
+                kind, f"{base}:{prog}", symbol_sha=symbol_sha,
+                input_sigs=sigs, mesh=self._mesh, fusion=fusion_mat,
+                extra={"prog": prog, "grad_req": grad_req_mat})
+
+        key_fwd, key_grad = _key("fwd"), _key("grad")
+
+        def _builder():
+            fwd, fwd_loss, loss_specs = build_graph_fns(sym)
+            return {
+                "fwd": compile_mod.JitProgram(fwd, key_fwd,
+                                              static_argnums=(3,)),
+                "grad": compile_mod.JitProgram(
+                    jax.grad(fwd_loss, argnums=0, has_aux=True),
+                    key_grad),
+                "loss_specs": loss_specs,
+            }
+
+        holder, _shared = compile_mod.shared_programs(key_fwd, _builder)
+        self._progs_holder = holder   # strong ref keeps the share alive
+        self._loss_specs = holder["loss_specs"]
+        self._fwd_jit = holder["fwd"]
+        self._fwd_loss_grad = holder["grad"]
 
     def _place(self, name, val):
         """Mesh placement for one argument value (no-op without a mesh)."""

@@ -2,9 +2,12 @@
 
 Faults are armed **by site and ordinal**, never randomly: a spec names a
 site (``ckpt_write``, ``nan_grad``, ``data_iter``, ``data_worker``,
-``dist_drop``, ``dist_init``, ``ckpt_truncate``) plus the exact
-coordinate at which it fires (byte offset, step index, batch index, call
-ordinal). ``data_iter`` fires on the consumer thread at an iterator's
+``dist_drop``, ``dist_init``, ``ckpt_truncate``, ``compile_cache``) plus
+the exact coordinate at which it fires (byte offset, step index, batch
+index, call ordinal). ``compile_cache`` covers both failure shapes of a
+persistent compile-cache entry (compile/cache.py): ``byte=N`` dies at
+byte N of the entry write, ``bytes=N`` truncates the entry after its
+rename commits. ``data_iter`` fires on the consumer thread at an iterator's
 B-th ``next()``; ``data_worker`` fires INSIDE a data-pipeline decode
 worker at the B-th produced batch (``data/pipeline.py``) — with
 ``action=kill`` it is the dying-input-worker drill the chaos suite

@@ -3,8 +3,17 @@
 TPU-native rebuild (reference: python/mxnet/module/bucketing_module.py:36).
 The reference shares one memory pool across bucket executors
 (graph_executor.cc:913 shared data pool); here each bucket is a separate
-XLA compilation (jit cache per shape) and XLA reuses buffers — the user-
-visible semantics (per-bucket symbols, shared parameters) are identical.
+XLA compilation and XLA reuses buffers — the user-visible semantics
+(per-bucket symbols, shared parameters) are identical.
+
+Compiled-program sharing (round 10): every per-bucket bind routes
+through the compile registry (``mxnet_tpu/compile/``) — ``switch_bucket``
+builds a Module whose Executor keys its programs by (symbol JSON, bound
+shapes/dtypes, grad_req, mesh, fusion flag), so two buckets whose
+symbols and shapes are identical share ONE compiled program, switching
+back to an already-seen bucket never recompiles, and
+``mx.compile_report()`` pins ``compiles == unique program keys``
+(tests/test_bucketing_lm.py).
 """
 from __future__ import annotations
 

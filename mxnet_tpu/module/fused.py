@@ -107,6 +107,8 @@ class FusedSymbolStep:
         self._metric_detach_epoch = 0   # bumped by detach_metrics
         self._t_dev = None
         self._step_jit = None
+        self._programs = {}     # feed signature -> compiled executable
+        self._jit_options = None
         self._lr_cache = None
         self.num_update = 0
         # partition decided at start() from actual value shapes
@@ -249,7 +251,6 @@ class FusedSymbolStep:
         pidx = {n: i for i, n in enumerate(self.param_names)}
         lr_mults = [self._lr_mults[pidx[n]] for n in self._big_names]
         wd_eff = [self._wd_eff[pidx[n]] for n in self._big_names]
-        base_key = self._base_key
         aux_names = self.aux_names
         has_flat = self._small_total > 0
         has_flat_aux = self._aux_total > 0
@@ -266,8 +267,13 @@ class FusedSymbolStep:
         out_names = self.symbol.list_outputs()
         guard = self.guard_enabled
 
+        # base_key is a runtime ARGUMENT, not a closure constant: baked
+        # into the executable it would make every process's programs
+        # unique (next_key() differs per run) and the persistent compile
+        # cache could never hit across restarts
         def step_fn(pvals, opt_state, flat_p, flat_state, aux_vals,
-                    flat_aux, mstate, fstate, feed_vals, t, lr):
+                    flat_aux, mstate, fstate, feed_vals, t, lr,
+                    base_key):
             key = jax.random.fold_in(base_key, t)
 
             def floss(pv, fp):
@@ -417,7 +423,7 @@ class FusedSymbolStep:
             arep = tuple(rep for _ in self._aux_big_names)
             mrep = tuple(rep for _ in (self._metric_state or ()))
             in_shardings = (prep, srep, frep, fsrep, arep, farep, mrep,
-                            rep, feed_sh, rep, rep)
+                            rep, feed_sh, rep, rep, rep)
             # pin state outputs to their input layout (keeps donation
             # zero-copy); leave graph outputs (None) to GSPMD
             out_shardings = (prep, srep, frep, fsrep, arep, farep, mrep,
@@ -429,6 +435,11 @@ class FusedSymbolStep:
         else:
             self._step_jit = jax.jit(step_fn, donate_argnums=donate,
                                      **jit_kw)
+        self._jit_options = jit_kw.get("compiler_options")
+        # compiled-program cache per feed signature: the jit above is
+        # only ever LOWERED — actual executables are acquired through
+        # the compile registry (AOT load-or-compile, compile/ package)
+        self._programs = {}
 
     def staging_sharding(self):
         """Sharding for batch inputs (data + labels), for the host data
@@ -553,14 +564,76 @@ class FusedSymbolStep:
                 lr_dev = jax.device_put(
                     lr_dev, NamedSharding(self.mesh, P()))
             self._lr_cache = (lr, lr_dev)
+        args = self._state_args() + (tuple(feed_vals), self._t_dev,
+                                     self._lr_cache[1], self._base_key)
+        sig = tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals)
+        prog = self._programs.get(sig)
+        if prog is None:
+            prog = self._acquire_program(sig, args)
+            self._programs[sig] = prog
         (self._pvals, self._opt_state, self._flat_p, self._flat_state,
          self._aux_vals, self._flat_aux, self._metric_state,
-         self._fault_state, outs, self._t_dev) = \
-            self._step_jit(*self._state_args(), tuple(feed_vals),
-                           self._t_dev, self._lr_cache[1])
+         self._fault_state, outs, self._t_dev) = prog(*args)
         self.num_update += 1
         self._check_abort()
         return outs
+
+    # -- compile registry / AOT cache (compile/ package) ----------------------
+    def _program_key(self, sig):
+        """Canonical cache key for the step program at one feed
+        signature. Everything that feeds the trace is material: graph,
+        shapes, optimizer hyperparameters (baked as constants),
+        mesh/sharding, fusion flag + site count, the FT guard, compute
+        dtype, attached metric slots, and compiler options."""
+        from .. import compile as compile_mod
+        from .. import config as _config
+        if not hasattr(self, "_symbol_sha"):
+            self._symbol_sha = compile_mod.symbol_digest(self.symbol)
+        fusion = {"flag": str(_config.get("MXTPU_PALLAS_FUSION")),
+                  "sites": len(self.fusion_report["sites"])
+                  if self.fusion_report else 0}
+        extra = {
+            "guard": bool(self.guard_enabled),
+            "compute_dtype": str(self.compute_dtype),
+            "data_axis": self.data_axis,
+            "trainable": sorted((n, bool(v))
+                                for n, v in self.trainable.items()),
+            "metrics": repr(tuple(self._metric_sigs)),
+            "compiler_options": self._jit_options,
+        }
+        return compile_mod.program_key(
+            "fused_step", f"fused_step:{self.symbol.name}",
+            symbol_sha=self._symbol_sha, input_sigs=sig,
+            optimizer=self.optimizer, mesh=self.mesh, fusion=fusion,
+            extra=extra)
+
+    def _acquire_program(self, sig, args):
+        """Route one compile through the registry: AOT-load from the
+        persistent cache when a valid entry exists (zero fresh XLA
+        compiles on a warm restart), else trace+compile inside a
+        ``compile::compile`` span and serialize back. Any failure of
+        the AOT machinery itself degrades to the plain jit — slower,
+        never wrong."""
+        from .. import compile as compile_mod
+        try:
+            key = self._program_key(sig)
+            exe, source = compile_mod.load_or_compile(
+                key, lambda: self._step_jit.lower(*args))
+            compile_mod.note_entry_point(key.name, key, sig)
+        except Exception as e:  # AOT path unavailable: degrade loudly
+            import logging
+            logging.getLogger("mxnet_tpu.compile").warning(
+                "fused step AOT compile path failed (%s); using the "
+                "plain jit", e)
+            from .. import fault as _fault
+            _fault.count("compile.aot_fallback")
+            return self._step_jit
+        if source != "cache":
+            return exe
+        jit_fn = self._step_jit
+        return compile_mod.guarded_loaded_program(
+            exe, jit_fn, "fused step",
+            on_reject=lambda: self._programs.__setitem__(sig, jit_fn))
 
     def _check_abort(self):
         """Lagged consecutive-skip abort (MXTPU_FT_MAX_CONSEC_SKIPS=K):
@@ -602,7 +675,8 @@ class FusedSymbolStep:
         if self._lr_cache is None:
             self._lr_cache = (0.0, jnp.asarray(0.0, jnp.float32))
         return self._step_jit.lower(*self._state_args(), feed_vals,
-                                    self._t_dev, self._lr_cache[1])
+                                    self._t_dev, self._lr_cache[1],
+                                    self._base_key)
 
     def step_cost(self, feed):
         """XLA cost analysis of the compiled step as a plain dict

@@ -179,17 +179,25 @@ class Predictor:
                 run_sym = fused_sym
 
         from ..executor import build_graph_fns
+        from .. import compile as compile_mod
         fwd, _, _ = build_graph_fns(run_sym)
         self._arg_names = arg_names
         key = jax.random.PRNGKey(0)
         cdt = self._cdt
         zero_args = set(self._zero_args)
-        pvals = self._pvals
+        # parameters are explicit ARGUMENTS of the compiled program (in
+        # arg order), not closure constants: baked-in values would bloat
+        # every executable with the full weight set and — worse — let a
+        # persistent-cache hit replay stale weights. As arguments, the
+        # executable is weight-independent and the program key only
+        # covers shapes/dtypes.
+        self._pval_names = [n for n in arg_names
+                            if n in self._pvals]
+        self._pvals_t = tuple(self._pvals[n] for n in self._pval_names)
+        pval_names = list(self._pval_names)
 
-        def infer_fn(data_vals, avals):
-            # traced once per bucket shape: the Python body only runs
-            # at trace time, so this counter IS the retrace counter
-            self._retraces += 1
+        def infer_fn(pvals_t, data_vals, avals):
+            pmap = dict(zip(pval_names, pvals_t))
             dmap = {}
             for n, v in zip(self.data_names, data_vals):
                 if cdt is not None and v.dtype == jnp.float32:
@@ -203,7 +211,7 @@ class Predictor:
                 if n in zero_args:
                     s = (bsz,) + tuple(arg_shape_map[n][1:])
                     return jnp.zeros(s, jnp.float32)
-                return pvals[n]
+                return pmap[n]
 
             outs, _ = fwd(tuple(val(n) for n in arg_names), avals, key,
                           False)
@@ -212,12 +220,17 @@ class Predictor:
                          for o in outs)
 
         # donate the request buffers: they are fresh padded arrays each
-        # call, so XLA may reuse them for outputs (the CPU backend
-        # cannot donate and warns per compile, so proxy runs skip it)
-        donate = {} if jax.default_backend() == "cpu" \
-            else {"donate_argnums": (0,)}
-        self._jit = jax.jit(infer_fn, **donate)
-        self._retraces = 0
+        # call, so XLA may reuse them for outputs (donation_supported is
+        # the compile subsystem's one home for the CPU-can't-donate
+        # policy — the old per-Predictor workaround for the per-compile
+        # backend warning)
+        donate = {"donate_argnums": (1,)} \
+            if compile_mod.donation_supported() else {}
+        self._infer_jit = jax.jit(infer_fn, **donate)
+        self._donate = bool(donate)
+        self._programs = {}     # (bucket, dtypes) -> compiled program
+        self._materialized = 0  # fresh traces taken BY this instance
+        self._cache_loads = 0   # bucket programs AOT-loaded from disk
         self._lock = threading.Lock()
         # per-bucket counters: calls, rows served, pad rows wasted
         self._bucket_calls = {b: 0 for b in self.buckets}
@@ -251,9 +264,67 @@ class Predictor:
 
     @property
     def retraces(self):
-        """Number of XLA traces taken — at most one per bucket after
-        warmup; tests pin this."""
-        return self._retraces
+        """Number of XLA traces this predictor took (compile-registry
+        accounting) — at most one per bucket after warmup, tests pin
+        this; ZERO when every bucket program AOT-loaded from a warm
+        ``MXTPU_COMPILE_CACHE_DIR``."""
+        return self._materialized
+
+    # -- compile registry / AOT cache (compile/ package) ----------------------
+    def _program_key(self, bucket, dtypes):
+        from .. import compile as compile_mod
+        from .. import config as _config
+        if not hasattr(self, "_symbol_sha"):
+            self._symbol_sha = compile_mod.symbol_digest(self.symbol)
+        sigs = tuple(
+            (n, (bucket,) + tuple(self.data_shapes[n]), dt)
+            for n, dt in zip(self.data_names, dtypes))
+        fusion = {"flag": str(_config.get("MXTPU_PALLAS_FUSION")),
+                  "sites": len(self.fusion_report["sites"])
+                  if self.fusion_report else 0}
+        extra = {
+            "compute_dtype": str(self._cdt),
+            "donate": self._donate,
+            "zero_args": sorted(self._zero_args),
+        }
+        return compile_mod.program_key(
+            "predictor", f"predictor:{self.symbol.name}:b{bucket}",
+            symbol_sha=self._symbol_sha, input_sigs=sigs, fusion=fusion,
+            extra=extra)
+
+    def _acquire_program(self, bucket, args):
+        """One compiled program per (bucket, request dtypes), acquired
+        through the compile registry: a warm persistent cache turns
+        warmup's per-bucket compile storm into file loads. Failures of
+        the AOT machinery degrade to the plain jit."""
+        from .. import compile as compile_mod
+        dtypes = tuple(str(a.dtype) for a in args[1])
+        try:
+            key = self._program_key(bucket, dtypes)
+            exe, source = compile_mod.load_or_compile(
+                key, lambda: self._infer_jit.lower(*args))
+            compile_mod.note_entry_point(
+                key.name, key, compile_mod.arg_signature(args[1]))
+        except Exception as e:
+            import logging
+            logging.getLogger("mxnet_tpu.compile").warning(
+                "predictor AOT compile path failed (%s); using the "
+                "plain jit", e)
+            from .. import fault as _fault
+            _fault.count("compile.aot_fallback")
+            self._materialized += 1
+            return self._infer_jit
+        if source == "cache":
+            self._cache_loads += 1
+            jit_fn = self._infer_jit
+
+            def _reject():
+                self._programs[(bucket, dtypes)] = jit_fn
+                self._materialized += 1
+            return compile_mod.guarded_loaded_program(
+                exe, jit_fn, "predictor", on_reject=_reject)
+        self._materialized += 1
+        return exe
 
     # -- execution ------------------------------------------------------------
     def _run_bucket(self, arrays, rows, bucket):
@@ -267,7 +338,13 @@ class Predictor:
                 a = np.concatenate([a, pad], axis=0)
             padded.append(jnp.asarray(a))
         with self._lock:
-            outs = self._jit(tuple(padded), self._avals)
+            args = (self._pvals_t, tuple(padded), self._avals)
+            pkey = (bucket, tuple(str(a.dtype) for a in padded))
+            fn = self._programs.get(pkey)
+            if fn is None:
+                fn = self._acquire_program(bucket, args)
+                self._programs[pkey] = fn
+            outs = fn(*args)
             self._bucket_calls[bucket] += 1
             self._bucket_rows[bucket] += rows
             self._bucket_pad_rows[bucket] += bucket - rows
@@ -323,20 +400,25 @@ class Predictor:
         return outs[0] if len(outs) == 1 else outs
 
     def warmup(self):
-        """Compile every bucket up front (serving must not pay a trace
-        on a live request). Returns the retrace count."""
+        """Materialize every bucket program up front (serving must not
+        pay a trace on a live request): AOT-loaded from the persistent
+        compile cache when a valid entry exists (``compile::load``
+        spans), freshly compiled otherwise (``compile::compile`` spans
+        — warmup cost is visible in ``mx.profiler`` dumps either way).
+        Returns the retrace (fresh trace) count — 0 on a warm cache."""
         for b in self.buckets:
             arrays = [np.zeros((b,) + self.data_shapes[n], np.float32)
                       for n in self.data_names]
             self._run_bucket(arrays, b, b)
-        return self._retraces
+        return self.retraces
 
     # -- observability --------------------------------------------------------
     def report(self, reset=False):
         with self._lock:
             out = {
                 "buckets": list(self.buckets),
-                "retraces": self._retraces,
+                "retraces": self._materialized,
+                "compile_cache_loads": self._cache_loads,
                 "per_bucket": {
                     b: {"calls": self._bucket_calls[b],
                         "rows": self._bucket_rows[b],

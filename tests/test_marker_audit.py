@@ -143,6 +143,26 @@ def test_data_pipeline_suite_stays_tier1_with_chaos_marked():
         "pytest.mark.chaos like the other fault-injection suites")
 
 
+def test_compile_cache_suite_stays_tier1():
+    """The compile-cache suite is tier-1's only proof that a warm
+    restart performs zero fresh XLA compiles and that a corrupt or
+    version-stale cache entry can never become a wrong program. It must
+    (a) exist, (b) carry the ``chaos`` marker (its corruption drills
+    ride the deterministic ``compile_cache`` faultinject site like the
+    other fault suites), and (c) never grow a ``slow`` mark that would
+    drop the acceptance pins from the ``-m 'not slow'`` gate."""
+    path = os.path.join(_TESTS, "test_compile_cache.py")
+    assert os.path.exists(path), "tests/test_compile_cache.py missing"
+    uses = _mark_uses()
+    assert "test_compile_cache.py" in uses.get("chaos", set()), (
+        "test_compile_cache.py must carry pytest.mark.chaos (module "
+        "pytestmark) — its corrupt/stale-entry drills are faultinject "
+        "chaos cases")
+    assert "test_compile_cache.py" not in uses.get("slow", set()), (
+        "test_compile_cache.py must stay tier-1: the zero-fresh-compile "
+        "warm-start subprocess pin is a round-10 acceptance criterion")
+
+
 def test_serving_fast_paths_stay_in_tier1():
     """Timing-SLO serving cases (throughput-efficiency pins) are
     ``slow``; everything functional — retrace pinning, shedding,

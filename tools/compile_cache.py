@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Manage a persistent compiled-program cache (MXTPU_COMPILE_CACHE_DIR).
+
+The cache (``mxnet_tpu/compile/``) holds one CRC-guarded ``.mxprog``
+entry per compiled XLA program — fused train steps and serving
+Predictor buckets — so restarts load executables instead of recompiling.
+This CLI is the operational surface:
+
+    compile_cache.py ls      [--dir D] [--json]
+    compile_cache.py verify  [--dir D] [--json]
+    compile_cache.py prune   [--dir D] [--max-age-days N]
+                             [--max-bytes B] [--dry-run]
+
+``ls`` tabulates entries (digest, entry point, kind, size, age, and
+whether the version fingerprint still matches the running stack);
+``verify`` fully validates every entry (header + fingerprint + payload
+CRC) and exits nonzero when any entry is corrupt or stale — a cheap CI
+gate for shared cache volumes; ``prune`` applies retention (age bound
+first, then oldest-first eviction to a size budget; invalid entries
+always go). Defaults come from MXTPU_COMPILE_CACHE_MAX_AGE_DAYS /
+MXTPU_COMPILE_CACHE_MAX_BYTES.
+
+Pure file-level operations: no backend is initialized, so this runs on
+a machine without the accelerator (e.g. a cache-volume janitor cron).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+
+def _cache(args):
+    from mxnet_tpu.compile.cache import PersistentCache
+    directory = args.dir or os.environ.get("MXTPU_COMPILE_CACHE_DIR", "")
+    if not directory:
+        sys.exit("no cache directory: pass --dir or set "
+                 "MXTPU_COMPILE_CACHE_DIR")
+    return PersistentCache(directory)
+
+
+def _age(header, path):
+    created = None
+    if isinstance(header, dict):
+        created = header.get("created")
+    if created is None:
+        created = os.path.getmtime(path)
+    return time.time() - float(created)
+
+
+def cmd_ls(args):
+    from mxnet_tpu.compile.cache import CacheEntryError
+    from mxnet_tpu.compile.key import fingerprint
+    cache = _cache(args)
+    rows = []
+    for path, header in cache.entries():
+        if isinstance(header, CacheEntryError):
+            rows.append({"digest": os.path.basename(path)[:10],
+                         "name": "?", "kind": "?", "status": header.reason,
+                         "size": os.path.getsize(path),
+                         "age_days": round(_age(None, path) / 86400, 2)})
+            continue
+        # fingerprint comparison needs no backend: it is version strings
+        rows.append({
+            "digest": header["digest"][:10],
+            "name": header.get("name", "?"),
+            "kind": header.get("kind", "?"),
+            "status": "ok" if header.get("fingerprint") == fingerprint()
+            else "stale",
+            "size": os.path.getsize(path),
+            "age_days": round(_age(header, path) / 86400, 2),
+        })
+    if args.json:
+        print(json.dumps({"dir": cache.directory, "entries": rows}))
+        return 0
+    print(f"{'digest':<12}{'kind':<16}{'status':<9}{'size':>10}"
+          f"{'age_d':>8}  name")
+    for r in rows:
+        print(f"{r['digest']:<12}{r['kind']:<16}{r['status']:<9}"
+              f"{r['size']:>10}{r['age_days']:>8.2f}  {r['name']}")
+    total = sum(r["size"] for r in rows)
+    print(f"-- {len(rows)} entries, {total / 1e6:.2f} MB in "
+          f"{cache.directory}")
+    return 0
+
+
+def cmd_verify(args):
+    cache = _cache(args)
+    ok, bad = cache.verify()
+    out = {"dir": cache.directory, "ok": ok,
+           "bad": [{"path": p, "reason": r} for p, r in bad]}
+    if args.json:
+        print(json.dumps(out))
+    else:
+        print(f"{ok} valid entries")
+        for p, r in bad:
+            print(f"BAD ({r}): {p}")
+    return 1 if bad else 0
+
+
+def cmd_prune(args):
+    import mxnet_tpu.config as config
+    cache = _cache(args)
+    max_age_days = args.max_age_days if args.max_age_days is not None \
+        else float(config.get("MXTPU_COMPILE_CACHE_MAX_AGE_DAYS"))
+    max_bytes = args.max_bytes if args.max_bytes is not None \
+        else int(config.get("MXTPU_COMPILE_CACHE_MAX_BYTES"))
+    if args.dry_run:
+        # report what WOULD go: run retention logic against a copy of
+        # the listing by re-deriving the same decisions
+        before = {p for p, _ in cache.entries()}
+        import shutil
+        import tempfile
+        tmp = tempfile.mkdtemp(prefix="mxcc-dry-")
+        try:
+            for p in before:
+                shutil.copy2(p, tmp)
+            from mxnet_tpu.compile.cache import PersistentCache
+            removed = PersistentCache(tmp).prune(
+                max_age_s=max_age_days * 86400 if max_age_days else None,
+                max_bytes=max_bytes or None)
+            removed = [(os.path.join(cache.directory,
+                                     os.path.basename(p)), why)
+                       for p, why in removed]
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    else:
+        removed = cache.prune(
+            max_age_s=max_age_days * 86400 if max_age_days else None,
+            max_bytes=max_bytes or None)
+    verb = "would remove" if args.dry_run else "removed"
+    if args.json:
+        print(json.dumps({"dir": cache.directory, "dry_run": args.dry_run,
+                          "removed": [{"path": p, "why": w}
+                                      for p, w in removed]}))
+    else:
+        for p, why in removed:
+            print(f"{verb} {os.path.basename(p)} ({why})")
+        print(f"-- {verb} {len(removed)} entries")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=None,
+                    help="cache directory (default: "
+                         "MXTPU_COMPILE_CACHE_DIR)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    ls = sub.add_parser("ls", help="list entries")
+    ls.add_argument("--json", action="store_true")
+    ver = sub.add_parser("verify", help="validate every entry "
+                                        "(CRC + fingerprint)")
+    ver.add_argument("--json", action="store_true")
+    pr = sub.add_parser("prune", help="apply retention (age + size)")
+    pr.add_argument("--max-age-days", type=float, default=None)
+    pr.add_argument("--max-bytes", type=int, default=None)
+    pr.add_argument("--dry-run", action="store_true")
+    pr.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    return {"ls": cmd_ls, "verify": cmd_verify,
+            "prune": cmd_prune}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
