@@ -36,25 +36,12 @@ _PEAK_TFLOPS = {
     "TPU v2": 46.0,
 }
 
-# HBM GB/s per chip (public spec sheets) — for the roofline report
-_PEAK_HBM_GBS = {
-    "TPU v5 lite": 819.0,
-    "TPU v5e": 819.0,
-    "TPU v5p": 2765.0,
-    "TPU v5": 2765.0,
-    "TPU v4 lite": 614.0,
-    "TPU v4": 1228.0,
-    "TPU v3": 900.0,
-    "TPU v2": 700.0,
-}
-
-
 def _peak_hbm(device) -> float:
-    kind = getattr(device, "device_kind", "")
-    for k, v in _PEAK_HBM_GBS.items():
-        if kind.startswith(k):
-            return v * 1e9
-    return 0.0
+    # the one HBM peak table lives in the telemetry subsystem — the
+    # bench roofline and the live step::roofline_fraction gauge must
+    # never disagree on the denominator
+    from mxnet_tpu.telemetry import peak_hbm_bytes_s
+    return peak_hbm_bytes_s(device)
 
 # ResNet-50 @224x224: ~4.089 GFLOP forward per image (2*MACs); training
 # ~= 3x forward (fwd + 2x in bwd).
@@ -634,6 +621,20 @@ print("BENCH " + json.dumps({
     except Exception:
         pass
 
+    # -- telemetry snapshot: the full unified report rides the BENCH
+    # JSON, so every BENCH_rNN.json doubles as a bytes-regression
+    # baseline for `tools/telemetry.py diff --gate-bytes` (the r6
+    # "strictly fewer bytes" pin, generalized)
+    telemetry_snapshot = None
+    try:
+        # round-trip through json here so an exotic value in some
+        # subsystem tree degrades to its repr instead of failing the
+        # whole BENCH print
+        telemetry_snapshot = json.loads(
+            json.dumps(mx.telemetry.report(), default=str))
+    except Exception:
+        pass
+
     print(json.dumps({
         "metric": "resnet50_train_throughput_per_chip",
         "value": round(img_s, 2),
@@ -697,6 +698,7 @@ print("BENCH " + json.dumps({
         "fault_tolerance": ft_stats,
         "input_pipeline": ip_stats,
         "cold_start": cold_start,
+        "telemetry": telemetry_snapshot,
         "host_decode_note": "multiprocess RecordIO->decode->augment->"
                             "batch rate on 480-short-side packed records, "
                             "no device involved; host_decode_img_s = "

@@ -51,6 +51,7 @@ from . import module
 from . import module as mod
 from . import monitor
 from . import monitor as mon
+from . import telemetry
 from . import profiler
 from . import rtc
 from . import config

@@ -277,6 +277,11 @@ class CheckpointManager:
         fault.count("ckpt.saves")
         self._valid_tags.add(tag)
         self._last_save_s = time.perf_counter() - t0
+        from .telemetry import export as _texp
+        if _texp.enabled():
+            _texp.emit_event("checkpoint", action="save", path=ckpt_dir,
+                             epoch=meta.get("epoch"),
+                             secs=round(self._last_save_s, 4))
         self.logger.info("Saved checkpoint '%s' (epoch %s, %.3fs)",
                          ckpt_dir, meta.get("epoch"), self._last_save_s)
         self.prune()
@@ -375,6 +380,10 @@ class CheckpointManager:
             from . import random as _random
             _random.set_state(state.rng)
         fault.count("ckpt.restores")
+        from .telemetry import export as _texp
+        if _texp.enabled():
+            _texp.emit_event("checkpoint", action="restore",
+                             path=state.path, epoch=state.epoch)
         return state
 
     # -- retention -------------------------------------------------------------

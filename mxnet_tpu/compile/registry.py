@@ -122,6 +122,20 @@ def _count(name, delta=1):
         pass
 
 
+def _emit_event(key, source, secs):
+    """Durable ``compile`` event (telemetry exporter; no-op unless
+    MXTPU_TELEMETRY_DIR is set — cold-start storms become visible in
+    the fleet event stream, not just the in-process report)."""
+    try:
+        from ..telemetry import export as _texp
+        if _texp.enabled():
+            _texp.emit_event("compile", name=key.name, kind=key.kind,
+                             digest=key.digest[:10], source=source,
+                             secs=round(secs, 4))
+    except Exception:
+        pass
+
+
 def note_entry_point(name, key, sig=None):
     """Retrace guard: one entry point (a fused step, a predictor, an
     executor) acquiring a program under a NEW key or argument signature
@@ -206,11 +220,13 @@ def load_or_compile(key, lower, cache=None):
                 blob, in_tree, out_tree = pickle.loads(payload)
                 exe = serialize_executable.deserialize_and_load(
                     blob, in_tree, out_tree)
-            rec.load_s += time.perf_counter() - t0
+            load_s = time.perf_counter() - t0
+            rec.load_s += load_s
             rec.cache_hits += 1
             rec.source = "cache"
             _count("compile.cache_hits")
             _refresh_prof_counters()
+            _emit_event(key, "cache", load_s)
             return exe, "cache"
         except Exception as e:
             # an entry that validated but won't deserialize (e.g. a
@@ -225,7 +241,8 @@ def load_or_compile(key, lower, cache=None):
     with _span("compile"):
         lowered = lower()
         exe = lowered.compile()
-    rec.compile_s += time.perf_counter() - t0
+    compile_s = time.perf_counter() - t0
+    rec.compile_s += compile_s
     rec.compiles += 1
     rec.source = "compile"
     _count("compile.fresh_compiles")
@@ -247,6 +264,7 @@ def load_or_compile(key, lower, cache=None):
                          key.short, e)
         rec.serialize_s += time.perf_counter() - t0
     _refresh_prof_counters()
+    _emit_event(key, "compile", compile_s)
     return exe, "compile"
 
 
@@ -386,7 +404,7 @@ def _refresh_prof_counters():
         pass
 
 
-def compile_report(reset=False):
+def _collect(reset=False):
     """Aggregate compile observability (``mx.compile_report()``):
 
     - ``programs``: one row per canonical program — fresh compiles,
@@ -396,6 +414,10 @@ def compile_report(reset=False):
     - ``totals``: summed counters (the subprocess warm-start tests pin
       ``fresh_compiles == 0`` on these);
     - ``cache``: the persistent-cache configuration in effect.
+
+    ``reset=True`` reads and clears inside ONE lock acquisition — a
+    compile landing between the read and the clear counts in exactly
+    one report window.
     """
     from .cache import cache_enabled
     from .. import config
@@ -404,6 +426,12 @@ def compile_report(reset=False):
         retraces = {n: {"count": e["count"],
                         "events": list(e["events"])}
                     for n, e in _retraces.items()}
+        if reset:
+            _records.clear()
+            _entry_points.clear()
+            _retraces.clear()
+    if reset:
+        _refresh_prof_counters()
     totals = {
         "programs": len(programs),
         "fresh_compiles": sum(p["compiles"] for p in programs),
@@ -414,8 +442,9 @@ def compile_report(reset=False):
         "load_s": round(sum(p["load_s"] for p in programs), 4),
         "retraces": sum(e["count"] for e in retraces.values()),
     }
-    out = {
-        "programs": sorted(programs, key=lambda p: -p["compile_s"]),
+    return {
+        "programs": sorted(programs,
+                           key=lambda p: (-p["compile_s"], p["name"])),
         "retraces": retraces,
         "totals": totals,
         "cache": {
@@ -424,9 +453,11 @@ def compile_report(reset=False):
             None,
         },
     }
-    if reset:
-        globals()["reset"]()
-    return out
+
+
+from ..telemetry import registry as _treg  # noqa: E402
+
+compile_report = _treg.collector_view("compile", _collect)
 
 
 def reset():

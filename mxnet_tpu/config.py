@@ -174,6 +174,20 @@ register("MXTPU_COMPILE_CACHE_MAX_BYTES", 0, int,
 register("MXTPU_COMPILE_CACHE_MAX_AGE_DAYS", 0.0, float,
          "Compile-cache retention age for tools/compile_cache.py prune; "
          "0 = keep forever")
+register("MXTPU_TELEMETRY_DIR", "", str,
+         "Durable telemetry export directory (telemetry/export.py): "
+         "rotating JSONL event log + periodic report snapshots land "
+         "here. Empty = in-memory telemetry only (registry/report stay "
+         "on)")
+register("MXTPU_TELEMETRY_ROTATE_BYTES", 4 * 1024 * 1024, int,
+         "Event-log segment size: events-NNNNN.jsonl rotates to the "
+         "next index past this many bytes")
+register("MXTPU_TELEMETRY_EVENT_STEPS", 50, int,
+         "Emit a train_step milestone event every N steps (step 1 "
+         "always emits so short runs still produce a log)")
+register("MXTPU_TELEMETRY_SNAPSHOT_STEPS", 500, int,
+         "Export a full telemetry snapshot every N train steps "
+         "(plus one at timeline close); 0 = close-time snapshot only")
 register("MXTPU_COMPILE_JAX_CACHE", True, bool,
          "Also point JAX's own persistent compilation cache at "
          "CACHE_DIR/xla (a second, backend-level layer on TPU/GPU; "

@@ -51,7 +51,7 @@ def _mirror_prof(wait_s, starvation):
         pass
 
 
-def data_report(reset=False):
+def _collect(reset=False):
     """Aggregate input-pipeline state across every live pipeline:
 
     - ``wait_s`` / ``waits`` / ``starvation_fraction``: total seconds,
@@ -90,3 +90,8 @@ def data_report(reset=False):
         "decode_items_s": round(tot_items / tot_busy, 2)
         if tot_busy > 0 else None,
     }
+
+
+from ..telemetry import registry as _treg  # noqa: E402
+
+data_report = _treg.collector_view("data", _collect)

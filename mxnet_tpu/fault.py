@@ -7,35 +7,38 @@ fault-injection harness (faultinject.py). ``mx.fault_report()`` is the one
 sync point: reading it pulls the guard's device counters to host (the
 guard itself never host-syncs per step).
 
-Modeled on ``mx.serving_report()`` (serving/__init__.py): module-level
-registry, weakrefs to live producers, ``reset=True`` to zero between
-measurement windows.
+Counters live in the unified telemetry registry (telemetry/registry.py)
+under the ``fault::`` namespace, so ``fault_report`` is the ``fault``
+subtree of ``mx.telemetry.report()`` and ``reset=True`` is the
+registry's atomic snapshot-and-clear — a concurrent ``count()`` lands
+in exactly one measurement window, never zero or two.
 """
 from __future__ import annotations
 
 import threading
 import weakref
 
+from .telemetry import registry as _treg
+
 __all__ = ["count", "add", "counters", "register_guard", "fault_report"]
 
 _lock = threading.Lock()
-_counters = {}
 _guards = []        # weakrefs to live FusedSymbolStep instances
+_PREFIX = "fault::"
 
 
 def count(name, delta=1):
     """Bump a named counter (dot-namespaced: ``ckpt.saves``,
     ``dist.collective_fallbacks``, ``injected.nan_grad``...)."""
-    with _lock:
-        _counters[name] = _counters.get(name, 0) + delta
+    _treg.counter(_PREFIX + name).inc(delta)
 
 
 add = count
 
 
 def counters():
-    with _lock:
-        return dict(_counters)
+    snap = _treg.snapshot(prefix=_PREFIX, kinds=("counter",))
+    return {k[len(_PREFIX):]: m["value"] for k, m in snap.items()}
 
 
 def register_guard(step):
@@ -50,9 +53,11 @@ _prof_counter = [None]
 
 
 def _update_prof_counter(val):
-    """Mirror the guard's skip total into a profiler ``ft::`` counter so
-    traces/aggregates show it alongside the ``ft::save``/``ft::load``
-    spans (checkpoint.py) and ``ft::dist_retry`` (parallel/dist.py)."""
+    """Mirror the guard's skip total into the ``ft::skipped_steps``
+    registry gauge (via the profiler Counter facade) so traces and
+    ``profiler.counters()`` show it alongside the ``ft::save``/
+    ``ft::load`` spans (checkpoint.py) and ``ft::dist_retry``
+    (parallel/dist.py)."""
     try:
         from . import profiler
         if _prof_counter[0] is None:
@@ -63,7 +68,7 @@ def _update_prof_counter(val):
         pass
 
 
-def fault_report(reset=False):
+def _collect(reset=False):
     """Aggregate fault-tolerance state:
 
     - ``skipped_steps`` / ``consecutive_skips``: non-finite training steps
@@ -91,10 +96,8 @@ def fault_report(reset=False):
         if reset:
             g.reset_fault_state()
     _update_prof_counter(skipped)
-    with _lock:
-        cs = dict(_counters)
-        if reset:
-            _counters.clear()
+    snap = _treg.snapshot(reset=reset, prefix=_PREFIX, kinds=("counter",))
+    cs = {k[len(_PREFIX):]: m["value"] for k, m in snap.items()}
 
     def _sub(prefix):
         plen = len(prefix) + 1
@@ -109,3 +112,6 @@ def fault_report(reset=False):
         "dist": _sub("dist"),
         "injected": _sub("injected"),
     }
+
+
+fault_report = _treg.collector_view("fault", _collect)

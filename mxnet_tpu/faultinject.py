@@ -2,9 +2,14 @@
 
 Faults are armed **by site and ordinal**, never randomly: a spec names a
 site (``ckpt_write``, ``nan_grad``, ``data_iter``, ``data_worker``,
-``dist_drop``, ``dist_init``, ``ckpt_truncate``, ``compile_cache``) plus
+``dist_drop``, ``dist_init``, ``ckpt_truncate``, ``compile_cache``,
+``telemetry_write``) plus
 the exact coordinate at which it fires (byte offset, step index, batch
-index, call ordinal). ``compile_cache`` covers both failure shapes of a
+index, call ordinal). ``telemetry_write`` is consulted by the durable
+telemetry exporter (telemetry/export.py) on every event append
+(``event=N``) and every log rotation (``rotation=K``); with
+``action=kill`` it is the kill-mid-write/mid-rotation drill that pins
+"the next run tails the event log cleanly, no torn JSONL line". ``compile_cache`` covers both failure shapes of a
 persistent compile-cache entry (compile/cache.py): ``byte=N`` dies at
 byte N of the entry write, ``bytes=N`` truncates the entry after its
 rename commits. ``data_iter`` fires on the consumer thread at an iterator's

@@ -285,27 +285,68 @@ class BaseModule:
                   sparse_row_id_fn, begin_epoch, num_epoch,
                   checkpoint_manager):
         """The per-epoch training loop body of :meth:`fit` (split out so
-        fit's pipeline/checkpoint lifecycle wraps it in one place)."""
+        fit's pipeline/checkpoint lifecycle wraps it in one place).
+
+        A :class:`~mxnet_tpu.telemetry.StepTimeline` spans the loop:
+        every step's wall time is attributed across data-wait /
+        H2D-staging / compile / device-step / metric+FT-sync phases
+        (the fused step attributes its inner phases into the same
+        timeline; nesting subtracts, so nothing double-counts), and —
+        with ``MXTPU_TELEMETRY_DIR`` set — step milestones, epoch ends,
+        and periodic report snapshots land in the durable event log.
+        """
+        from ..telemetry import StepTimeline, export as _texp
+        sym_name = getattr(self._symbol, "name", None) or "module"
+        tl = StepTimeline(name=f"fit:{sym_name}").activate()
+        try:
+            self.__fit_epochs(train_data, eval_data, eval_metric,
+                              validation_metric, epoch_end_callback,
+                              batch_end_callback, eval_end_callback,
+                              eval_batch_end_callback, monitor,
+                              sparse_row_id_fn, begin_epoch, num_epoch,
+                              checkpoint_manager, tl, _texp)
+        finally:
+            tl.close()
+
+    def __fit_epochs(self, train_data, eval_data, eval_metric,
+                     validation_metric, epoch_end_callback,
+                     batch_end_callback, eval_end_callback,
+                     eval_batch_end_callback, monitor, sparse_row_id_fn,
+                     begin_epoch, num_epoch, checkpoint_manager, tl,
+                     _texp):
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             nbatch = 0
             data_iter = iter(train_data)
             end_of_batch = False
-            next_data_batch = next(data_iter)
+            # open the first step's wall clock before the epoch-start
+            # fetch: the initial data wait (iterator re-init, pipeline
+            # warm-up) is attributed to the epoch's first step — the
+            # loop's step_start below is a no-op while the step is open
+            tl.step_start()
+            with tl.phase("data_wait"):
+                next_data_batch = next(data_iter)
             while not end_of_batch:
                 data_batch = next_data_batch
+                tl.step_start()
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
+                # the outer span: the fused step's inner h2d_stage /
+                # compile / device_step phases nest inside and claim
+                # their share; the eager path books it all here
+                with tl.phase("device_step"):
+                    self.forward_backward(data_batch)
+                    self.update()
                 try:
-                    next_data_batch = next(data_iter)
+                    with tl.phase("data_wait"):
+                        next_data_batch = next(data_iter)
                     self.prepare(next_data_batch,
                                  sparse_row_id_fn=sparse_row_id_fn)
                 except StopIteration:
                     end_of_batch = True
-                self.update_metric(eval_metric, data_batch.label)
+                with tl.phase("metric_ft_sync"):
+                    self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
@@ -314,12 +355,19 @@ class BaseModule:
                         locals=locals())
                     for callback in _as_list(batch_end_callback):
                         callback(batch_end_params)
+                tl.step_end(epoch=epoch)
                 nbatch += 1
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             toc = time.time()
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            if _texp.enabled():
+                _texp.emit_event(
+                    "epoch", name=tl.name, epoch=epoch, nbatch=nbatch,
+                    time_s=round(toc - tic, 4),
+                    metrics={n: float(v) for n, v
+                             in eval_metric.get_name_value()})
 
             # the reference pulls params to host and re-broadcasts every
             # epoch (base_module.py:617) to consolidate multi-device aux;

@@ -26,6 +26,7 @@ every elementwise rule; norm-based rules like LARS disable packing).
 from __future__ import annotations
 
 import pickle
+import weakref
 
 import numpy as np
 
@@ -108,6 +109,8 @@ class FusedSymbolStep:
         self._t_dev = None
         self._step_jit = None
         self._programs = {}     # feed signature -> compiled executable
+        self._program_costs = {}  # feed signature -> XLA cost dict
+        self._noted_cost = None   # (timeline weakref, sig) last noted
         self._jit_options = None
         self._lr_cache = None
         self.num_update = 0
@@ -438,8 +441,14 @@ class FusedSymbolStep:
         self._jit_options = jit_kw.get("compiler_options")
         # compiled-program cache per feed signature: the jit above is
         # only ever LOWERED — actual executables are acquired through
-        # the compile registry (AOT load-or-compile, compile/ package)
+        # the compile registry (AOT load-or-compile, compile/ package).
+        # The recorded costs die with the programs: a rebuilt step (new
+        # metric slots, new guard config) has a different bytes budget,
+        # and cost_analysis()/the step gauges must never answer from
+        # the old program's numbers
         self._programs = {}
+        self._program_costs = {}
+        self._noted_cost = None
 
     def staging_sharding(self):
         """Sharding for batch inputs (data + labels), for the host data
@@ -546,17 +555,26 @@ class FusedSymbolStep:
                 v = jnp.asarray(feed[n])
                 if jnp.issubdtype(v.dtype, jnp.floating):
                     feed[n] = v * jnp.nan
+        # step-time attribution (telemetry/timeline.py): the phases
+        # below nest inside fit()'s outer device_step span, so their
+        # time is attributed here and subtracted there — no double
+        # counting, and the step costs two attribute reads when no
+        # timeline is active
+        from ..telemetry import timeline as _tlmod
+        tl = _tlmod.current()
         feed_vals = []
         shard_inputs = set(self.data_names) | set(self.label_names)
-        for n in self.input_names:
-            if n not in feed:
-                raise MXNetError(f"fused step missing input '{n}'")
-            v = feed[n]
-            if self.mesh is not None:
-                from jax.sharding import NamedSharding, PartitionSpec as P
-                spec = P(self.data_axis) if n in shard_inputs else P()
-                v = jax.device_put(v, NamedSharding(self.mesh, spec))
-            feed_vals.append(v)
+        with tl.phase("h2d_stage") if tl else _tlmod.null_phase():
+            for n in self.input_names:
+                if n not in feed:
+                    raise MXNetError(f"fused step missing input '{n}'")
+                v = feed[n]
+                if self.mesh is not None:
+                    from jax.sharding import NamedSharding, \
+                        PartitionSpec as P
+                    spec = P(self.data_axis) if n in shard_inputs else P()
+                    v = jax.device_put(v, NamedSharding(self.mesh, spec))
+                feed_vals.append(v)
         if self._lr_cache is None or self._lr_cache[0] != lr:
             lr_dev = jnp.asarray(lr, jnp.float32)
             if self.mesh is not None:
@@ -569,13 +587,28 @@ class FusedSymbolStep:
         sig = tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals)
         prog = self._programs.get(sig)
         if prog is None:
-            prog = self._acquire_program(sig, args)
+            with tl.phase("compile") if tl else _tlmod.null_phase():
+                prog = self._acquire_program(sig, args)
             self._programs[sig] = prog
-        (self._pvals, self._opt_state, self._flat_p, self._flat_state,
-         self._aux_vals, self._flat_aux, self._metric_state,
-         self._fault_state, outs, self._t_dev) = prog(*args)
+        if tl is not None:
+            # the cost only changes with the program — note it once per
+            # (timeline, sig), not with per-step gauge writes under the
+            # registry lock on the hottest loop
+            noted = self._noted_cost
+            if noted is None or noted[0]() is not tl or noted[1] != sig:
+                cost = self._program_costs.get(sig)
+                if cost:
+                    tl.note_cost(flops=cost.get("flops"),
+                                 bytes_accessed=cost.get("bytes accessed"))
+                    self._noted_cost = (weakref.ref(tl), sig)
+        with tl.phase("device_step") if tl else _tlmod.null_phase():
+            (self._pvals, self._opt_state, self._flat_p,
+             self._flat_state, self._aux_vals, self._flat_aux,
+             self._metric_state, self._fault_state, outs,
+             self._t_dev) = prog(*args)
         self.num_update += 1
-        self._check_abort()
+        with tl.phase("metric_ft_sync") if tl else _tlmod.null_phase():
+            self._check_abort()
         return outs
 
     # -- compile registry / AOT cache (compile/ package) ----------------------
@@ -628,12 +661,39 @@ class FusedSymbolStep:
             from .. import fault as _fault
             _fault.count("compile.aot_fallback")
             return self._step_jit
+        self._note_cost(sig, exe)
         if source != "cache":
             return exe
         jit_fn = self._step_jit
         return compile_mod.guarded_loaded_program(
             exe, jit_fn, "fused step",
             on_reject=lambda: self._programs.__setitem__(sig, jit_fn))
+
+    def _note_cost(self, sig, exe):
+        """Record XLA cost analysis of an already-compiled step program
+        (bytes-accessed is THE optimization currency in the
+        bandwidth-bound regime) — read off the executable we just
+        acquired, never a second lower+compile. Feeds the
+        ``step::bytes_accessed`` / ``flops`` / arithmetic-intensity
+        gauges; the active StepTimeline derives roofline-fraction from
+        the same numbers. Best-effort: some backends/AOT-loaded
+        executables don't expose cost analysis."""
+        try:
+            cost = exe.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            cost = dict(cost) if cost else {}
+        except Exception:
+            cost = {}
+        self._program_costs[sig] = cost
+        if not cost:
+            return
+        try:
+            from ..telemetry.timeline import set_step_cost
+            set_step_cost(flops=cost.get("flops"),
+                          bytes_accessed=cost.get("bytes accessed"))
+        except Exception:
+            pass
 
     def _check_abort(self):
         """Lagged consecutive-skip abort (MXTPU_FT_MAX_CONSEC_SKIPS=K):
@@ -683,7 +743,14 @@ class FusedSymbolStep:
         (keys like "flops", "bytes accessed"; {} when unavailable).
         The single unwrap point for the per-computation list some jax
         versions return — bench.py, tools/perf_sweep.py and the fusion
-        A/B tests all read costs through here."""
+        A/B tests all read costs through here. A program already
+        acquired by :meth:`step` answers from the recorded cost
+        (``_note_cost``) instead of paying a second lower+compile."""
+        sig = tuple((tuple(feed[n].shape), str(feed[n].dtype))
+                    for n in self.input_names)
+        cached = self._program_costs.get(sig)
+        if cached:
+            return dict(cached)
         cost = self.lowered(feed).compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}

@@ -13,6 +13,16 @@ aggregate_stats.cc). Two layers:
   per-kernel device time (XLA fuses ops; per-fused-kernel timing lives in
   the trace above).
 
+Since round 11 both host-side stores live in the unified telemetry
+registry (``mxnet_tpu/telemetry/registry.py``): span/op aggregates are
+registry :class:`~mxnet_tpu.telemetry.registry.Timer` metrics under the
+``prof::`` namespace and :class:`Counter` values are registry gauges —
+``profiler.counters()``, ``mx.telemetry.report()`` and every subsystem
+mirror (``data::wait_s``, ``ft::skipped_steps``, ``compile::…``) read
+ONE store, so the mirrors can never drift, and ``dumps(reset=True)`` is
+the registry's atomic snapshot-and-clear (no samples lost between the
+read and the clear).
+
 Also provides the Domain/Task/Frame/Event/Counter/Marker object API
 (reference: profiler.py:151-400) mapped onto jax.profiler traces or
 host-side records.
@@ -22,10 +32,10 @@ from __future__ import annotations
 import atexit
 import json
 import os
-import tempfile
 import time
-from collections import defaultdict
-from typing import Dict, List, Optional
+from typing import Dict, Optional
+
+from .telemetry import registry as _treg
 
 __all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
            "state", "counters", "Domain", "Task", "Frame", "Event",
@@ -43,10 +53,15 @@ _config = {
 _state = "stop"
 _trace_dir: Optional[str] = None
 _jax_trace_active = False
-
-# aggregate table: name -> [count, total_s, min_s, max_s]
-_agg: Dict[str, List[float]] = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
 _paused = False
+
+# aggregate entries live in the telemetry registry as Timers under this
+# namespace; dumps() strips it so table keys stay the bare op/span names
+_PROF = "prof::"
+
+
+def _agg_record(name, dt):
+    _treg.timer(_PROF + name).record(dt)
 
 
 def set_config(**kwargs):
@@ -133,27 +148,40 @@ def dump_profile():
     dump(True)
 
 
+def aggregate(reset=False):
+    """The aggregate table as ``{name: (count, total_s, min_s, max_s)}``
+    — one atomic registry snapshot (``reset=True`` clears in the same
+    lock acquisition, so a concurrent span/op can never land in neither
+    or both windows). Zero-count rows (a handle created but nothing
+    recorded this window, e.g. right after a reset) are omitted: they
+    carry no data and their undefined min must never render as
+    ``inf``."""
+    snap = _treg.snapshot(reset=reset, prefix=_PROF,
+                          kinds=("timer", "histogram"))
+    return {name[len(_PROF):]: (m["count"], m["total"], m["min"], m["max"])
+            for name, m in snap.items() if m["count"]}
+
+
 def dumps(reset=False, format="table"):
     """Return aggregate operator stats (reference: profiler.py:127-140;
-    native aggregate_stats.cc table)."""
-    rows = sorted(_agg.items(), key=lambda kv: -kv[1][1])
+    native aggregate_stats.cc table). Rows sort by total time
+    descending with the name as tiebreaker (stable across identical
+    totals); zero-count rows render 0.0, never ``inf``."""
+    rows = sorted(aggregate(reset=reset).items(),
+                  key=lambda kv: (-kv[1][1], kv[0]))
     if format == "json":
         out = json.dumps({
             name: {"count": int(c), "total_ms": t * 1e3,
-                   "min_ms": (mn if mn != float("inf") else 0.0) * 1e3,
-                   "max_ms": mx * 1e3}
+                   "min_ms": mn * 1e3, "max_ms": mx * 1e3}
             for name, (c, t, mn, mx) in rows})
     else:
         lines = [f"{'operator':<32}{'count':>8}{'total_ms':>12}"
                  f"{'avg_ms':>10}{'min_ms':>10}{'max_ms':>10}"]
         for name, (c, t, mn, mx) in rows:
-            mn = 0.0 if mn == float("inf") else mn
             avg = t / c if c else 0.0
             lines.append(f"{name:<32}{int(c):>8}{t * 1e3:>12.3f}"
                          f"{avg * 1e3:>10.3f}{mn * 1e3:>10.3f}{mx * 1e3:>10.3f}")
         out = "\n".join(lines)
-    if reset:
-        _agg.clear()
     return out
 
 
@@ -170,6 +198,7 @@ def _install_op_timer():
             or _config["profile_all"]):
         return
     from .ndarray import ndarray as _nd_mod
+    handles: Dict[str, object] = {}   # op name -> registry Timer
 
     def timing_hook(impl, name, nd_inputs, attrs):
         if _paused:
@@ -177,11 +206,10 @@ def _install_op_timer():
         t0 = time.perf_counter()
         out = impl(name, nd_inputs, attrs)
         dt = time.perf_counter() - t0
-        ent = _agg[name]
-        ent[0] += 1
-        ent[1] += dt
-        ent[2] = min(ent[2], dt)
-        ent[3] = max(ent[3], dt)
+        h = handles.get(name)
+        if h is None:
+            h = handles[name] = _treg.timer(_PROF + name)
+        h.record(dt)
         return out
 
     _nd_mod._PROFILE_HOOK = timing_hook
@@ -250,6 +278,7 @@ class _Span:
         self.name = name
         self._t0 = None
         self._ann = None
+        self._timer = None     # registry handle, resolved at first stop
 
     def start(self):
         self._t0 = time.perf_counter()
@@ -270,12 +299,10 @@ class _Span:
             self._ann = None
         if self._t0 is not None:
             dt = time.perf_counter() - self._t0
-            key = f"{self.domain}::{self.name}"
-            ent = _agg[key]
-            ent[0] += 1
-            ent[1] += dt
-            ent[2] = min(ent[2], dt)
-            ent[3] = max(ent[3], dt)
+            if self._timer is None:
+                self._timer = _treg.timer(
+                    f"{_PROF}{self.domain}::{self.name}")
+            self._timer.record(dt)
             self._t0 = None
         return self
 
@@ -301,42 +328,43 @@ class Event(_Span):
         super().__init__("event", name)
 
 
-_live_counters: Dict[str, float] = {}
-
-
 def counters():
-    """Last value of every live :class:`Counter`, keyed ``domain::name``
-    — how the subsystem gauges (``ft::skipped_steps``, ``data::wait_s``,
-    ``data::starvation_fraction``…) surface without a trace viewer."""
-    return dict(_live_counters)
+    """Last value of every live gauge, keyed ``domain::name`` — how the
+    subsystem gauges (``ft::skipped_steps``, ``data::wait_s``,
+    ``step::bytes_accessed``…) surface without a trace viewer. Reads
+    the one telemetry registry: a :class:`Counter` created here and a
+    gauge set anywhere else under the same name are the SAME metric."""
+    return {name: m["value"]
+            for name, m in _treg.snapshot(kinds=("gauge",)).items()}
 
 
 class Counter:
-    """Numeric counter (reference: profiler.py:330). Values are mirrored
-    into the process-wide :func:`counters` table."""
+    """Numeric counter (reference: profiler.py:330). Backed by a
+    telemetry registry gauge named ``domain::name`` — the process-wide
+    :func:`counters` table IS the registry's gauge namespace."""
 
     def __init__(self, domain, name, value=None):
         self.domain = domain
         self.name = name
-        self.value = 0
-        self._record()
+        # the registry gauge starts at 0; do NOT zero it here — a
+        # second facade over an existing domain::name (the mirrors are
+        # the SAME metric) must never erase another producer's value
+        self._gauge = _treg.gauge(f"{domain}::{name}")
         if value is not None:
             self.set_value(value)
 
-    def _record(self):
-        _live_counters[f"{self.domain}::{self.name}"] = self.value
+    @property
+    def value(self):
+        return self._gauge.get()
 
     def set_value(self, value):
-        self.value = value
-        self._record()
+        self._gauge.set(value)
 
     def increment(self, delta=1):
-        self.value += delta
-        self._record()
+        self._gauge.inc(delta)
 
     def decrement(self, delta=1):
-        self.value -= delta
-        self._record()
+        self._gauge.inc(-delta)
 
     def __iadd__(self, v):
         self.increment(v)
@@ -355,5 +383,21 @@ class Marker:
         self.name = name
 
     def mark(self, scope="process"):
-        ent = _agg[f"{self.domain}::{self.name}::marks"]
-        ent[0] += 1
+        # a zero-length record: count advances, totals stay 0 — the
+        # reference's instant-marker row in the aggregate table
+        _agg_record(f"{self.domain}::{self.name}::marks", 0.0)
+
+
+def _collect(reset=False):
+    """The ``profiler`` subsystem view in ``mx.telemetry.report()``:
+    the live gauge table + the aggregate span/op table."""
+    return {
+        "counters": counters(),
+        "aggregate": {
+            name: {"count": int(c), "total_s": round(t, 6),
+                   "min_s": round(mn, 6), "max_s": round(mx, 6)}
+            for name, (c, t, mn, mx) in aggregate(reset=reset).items()},
+    }
+
+
+_treg.register_collector("profiler", _collect)

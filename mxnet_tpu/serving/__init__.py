@@ -55,29 +55,62 @@ class DeadlineExceeded(ServingError):
 
 
 # live Predictor/DynamicBatcher instances; serving_report() walks these.
-# WeakSets so a dropped server never pins device buffers.
+# WeakSets so a dropped server never pins device buffers. Every
+# instance gets a stable process-unique id at registration (fleet
+# readiness: two Predictor replicas in one process must never merge
+# into an anonymous pool — ROADMAP item 3's router aggregates
+# per-replica by this id).
+import itertools as _itertools
+
 _PREDICTORS: "weakref.WeakSet" = weakref.WeakSet()
 _BATCHERS: "weakref.WeakSet" = weakref.WeakSet()
+_PRED_SEQ = _itertools.count()
+_BATCH_SEQ = _itertools.count()
 
 
 def _register_predictor(p):
+    p.telemetry_id = f"{p.symbol.name or 'predictor'}#{next(_PRED_SEQ)}"
     _PREDICTORS.add(p)
+    # the id is process-unique, so every serving::<id>::… registry
+    # series belongs to exactly this replica — drop them when it dies,
+    # or replica churn (model reloads) grows the registry and every
+    # report/scrape without bound
+    from ..telemetry import registry as treg
+    weakref.finalize(p, treg.remove, f"serving::{p.telemetry_id}::")
 
 
 def _register_batcher(b):
+    b.telemetry_id = f"{b.name}#{next(_BATCH_SEQ)}"
     _BATCHERS.add(b)
 
 
-def serving_report(reset: bool = False) -> dict:
+def _collect(reset: bool = False) -> dict:
     """Aggregate serving observability: one entry per live Predictor
     (per-bucket compile/call/pad counters, retraces) and per live
     DynamicBatcher (per-bucket p50/p99 latency, queue depth, batch
-    occupancy, shed/deadline counters). ``reset=True`` clears the
-    latency windows and counters after reading."""
-    return {
-        "predictors": [p.report(reset=reset) for p in list(_PREDICTORS)],
-        "batchers": [b.report(reset=reset) for b in list(_BATCHERS)],
+    occupancy, shed/deadline counters), each tagged with its stable
+    ``id`` and sorted by it (WeakSet iteration order is arbitrary —
+    reads must be correlatable across time and replicas).
+    ``reset=True`` clears the latency windows and counters after
+    reading (each instance snapshot-and-clears under its own lock),
+    including the per-predictor ``serving::…`` registry series — one
+    reset, every serving surface starts a fresh window."""
+    out = {
+        "predictors": sorted(
+            (p.report(reset=reset) for p in list(_PREDICTORS)),
+            key=lambda r: r["id"]),
+        "batchers": sorted(
+            (b.report(reset=reset) for b in list(_BATCHERS)),
+            key=lambda r: r["id"]),
     }
+    if reset:
+        _treg.reset(prefix="serving::")
+    return out
+
+
+from ..telemetry import registry as _treg  # noqa: E402
+
+serving_report = _treg.collector_view("serving", _collect)
 
 
 from .predictor import Predictor           # noqa: E402

@@ -42,7 +42,6 @@ from . import DeadlineExceeded, Overloaded, _register_batcher
 
 __all__ = ["DynamicBatcher", "ServingFuture"]
 
-_LAT_WINDOW = 2048  # per-bucket latency samples kept (ring buffer)
 _DEADLINE_SLACK_S = 0.002  # launch this early so an at-deadline
                            # request is still live when collected
 
@@ -127,13 +126,21 @@ class DynamicBatcher:
         self._running = False
         self._thread = None
         # observability (guarded by _lock)
-        self._lat = {b: [] for b in predictor.buckets}  # seconds
         self._occ_rows = {b: 0 for b in predictor.buckets}
         self._occ_batches = {b: 0 for b in predictor.buckets}
         self._shed = 0
         self._deadline_missed = 0
         self._served = 0
         _register_batcher(self)
+        # registry histograms keyed by the PREDICTOR id (not just the
+        # batcher name): two replicas serving the same model in one
+        # process stay separate series a fleet router can aggregate
+        from ..telemetry import registry as treg
+        pid = self.predictor.telemetry_id
+        self._lat_hist = {
+            b: treg.histogram(f"serving::{pid}::b{b}::latency_ms")
+            for b in predictor.buckets}
+        self._batches_c = treg.counter(f"serving::{pid}::batches")
 
     # -- lifecycle ------------------------------------------------------------
     def start(self):
@@ -311,10 +318,13 @@ class DynamicBatcher:
                 self._occ_rows[bucket] += rows
                 self._occ_batches[bucket] += 1
                 self._served += len(batch)
-                lat = self._lat[bucket]
-                for r in batch:
-                    lat.append(now - r.t_submit)
-                del lat[:-_LAT_WINDOW]
+            # the registry histogram IS the latency window (one store:
+            # report() and the telemetry/Prometheus surfaces read the
+            # same sliding samples, so their percentiles cannot differ)
+            hist = self._lat_hist[bucket]
+            for r in batch:
+                hist.observe((now - r.t_submit) * 1e3)
+            self._batches_c.inc()
             start = 0
             batched = self.predictor.out_batched
             for r in batch:
@@ -325,6 +335,17 @@ class DynamicBatcher:
                 r.future._complete(
                     result=mine[0] if len(mine) == 1 else mine)
                 start += r.rows
+            # durable event AFTER the futures complete: the exporter's
+            # locked disk append must never sit on the client-visible
+            # response path
+            from ..telemetry import export as _texp
+            if _texp.enabled():
+                _texp.emit_event(
+                    "serving_batch", batcher=self.telemetry_id,
+                    predictor=self.predictor.telemetry_id,
+                    bucket=bucket, rows=rows, requests=len(batch),
+                    max_latency_ms=round(max(
+                        (now - r.t_submit) * 1e3 for r in batch), 3))
 
     # -- observability --------------------------------------------------------
     @property
@@ -334,23 +355,26 @@ class DynamicBatcher:
             return self._queued_rows
 
     def report(self, reset=False):
+        from ..telemetry import registry as treg
         with self._lock:
             per_bucket = {}
             for b in self.predictor.buckets:
-                lat = self._lat[b]
+                h = self._lat_hist[b]
+                hsnap = treg.snapshot(reset=reset,
+                                      prefix=h.name).get(h.name, {})
                 nb = self._occ_batches[b]
                 per_bucket[b] = {
                     "batches": nb,
                     "rows": self._occ_rows[b],
                     "occupancy": (self._occ_rows[b] / (nb * b))
                     if nb else None,
-                    "p50_ms": float(np.percentile(lat, 50)) * 1e3
-                    if lat else None,
-                    "p99_ms": float(np.percentile(lat, 99)) * 1e3
-                    if lat else None,
+                    "p50_ms": hsnap.get("p50"),
+                    "p99_ms": hsnap.get("p99"),
                 }
             out = {
+                "id": self.telemetry_id,
                 "name": self.name,
+                "predictor_id": self.predictor.telemetry_id,
                 "max_batch": self.max_batch,
                 "max_wait_us": self.max_wait_us,
                 "max_queue": self.max_queue,
@@ -363,7 +387,6 @@ class DynamicBatcher:
             }
             if reset:
                 for b in self.predictor.buckets:
-                    self._lat[b] = []
                     self._occ_rows[b] = 0
                     self._occ_batches[b] = 0
                 self._shed = 0

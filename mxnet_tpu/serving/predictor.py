@@ -416,6 +416,7 @@ class Predictor:
     def report(self, reset=False):
         with self._lock:
             out = {
+                "id": self.telemetry_id,
                 "buckets": list(self.buckets),
                 "retraces": self._materialized,
                 "compile_cache_loads": self._cache_loads,

@@ -75,7 +75,7 @@ def fusion_enabled() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def fusion_report(reset: bool = False) -> dict:
+def _collect(reset: bool = False) -> dict:
     """What the fusion pass rewrote in this process: per-rewrite site
     lists (conv/bn/activation node names + matmul geometry and tiles)
     and per-site bail-out reasons. One entry per executor build;
@@ -83,19 +83,26 @@ def fusion_report(reset: bool = False) -> dict:
     (``executor`` = train/grad builds, ``executor_infer`` = inference-
     only executor binds, ``fused_step`` = the whole-step train program,
     ``predictor`` = serving predict programs)."""
+    reports = list(_REPORTS)
+    if reset:
+        # clear exactly what was read: a rewrite landing concurrently
+        # stays for the next window instead of vanishing unreported
+        del _REPORTS[:len(reports)]
     by_tag: Dict[str, int] = {}
-    for r in _REPORTS:
+    for r in reports:
         by_tag[r.get("tag", "?")] = \
             by_tag.get(r.get("tag", "?"), 0) + len(r["sites"])
-    out = {
-        "num_rewritten_sites": sum(len(r["sites"]) for r in _REPORTS),
-        "num_bailouts": sum(len(r["bailouts"]) for r in _REPORTS),
+    return {
+        "num_rewritten_sites": sum(len(r["sites"]) for r in reports),
+        "num_bailouts": sum(len(r["bailouts"]) for r in reports),
         "by_tag": by_tag,
-        "rewrites": list(_REPORTS),
+        "rewrites": reports,
     }
-    if reset:
-        _REPORTS.clear()
-    return out
+
+
+from ..telemetry import registry as _treg  # noqa: E402
+
+fusion_report = _treg.collector_view("fusion", _collect)
 
 
 def _record(report: dict):

@@ -1,0 +1,49 @@
+"""Unified telemetry: one registry, step-time attribution, durable export.
+
+The observability layer everything reports into (``mx.telemetry``):
+
+- **registry.py** — the process-wide metrics registry (counters,
+  gauges, timers, histograms with p50/p99, all named
+  ``subsystem::name``) with ONE atomic snapshot-and-clear ``reset``.
+  The six legacy report surfaces — ``fusion_report``,
+  ``serving_report``, ``data_report``, ``fault_report``,
+  ``compile_report``, ``profiler.counters`` — register collectors here
+  and became filtered views of :func:`report`, which is therefore a
+  strict superset of all of them (pinned in tests/test_telemetry.py).
+- **timeline.py** — :class:`StepTimeline`: ``fit()`` attributes every
+  step's wall time across data-wait / H2D / compile / device-step /
+  metric-sync phases, and the fused step records XLA cost-analysis
+  bytes-accessed from the already-compiled program — live
+  arithmetic-intensity and roofline-fraction gauges for the
+  bandwidth-bound regime (ROADMAP item 2's currency).
+- **export.py** — with ``MXTPU_TELEMETRY_DIR`` set: rotating JSONL
+  event log (train-step milestones, serving batches, checkpoint and
+  compile-cache events), periodic atomic report snapshots, and a
+  Prometheus-style text rendering. ``tools/telemetry.py`` tails,
+  summarizes, and diffs the exports; ``diff --gate-bytes`` is the
+  reusable bytes-accessed regression gate.
+
+Everything here is observability: failures count and log, they never
+take down the training step or the serving loop.
+"""
+from __future__ import annotations
+
+from . import registry
+from . import timeline
+from . import export
+from .registry import (Counter, Gauge, Timer, Histogram, counter, gauge,
+                       timer, histogram, snapshot, report, collect,
+                       register_collector, reset, remove)
+from .timeline import (StepTimeline, current, peak_hbm_bytes_s,
+                       set_step_cost)
+from .export import (enabled, telemetry_dir, emit_event, export_snapshot,
+                     render_prometheus, read_events)
+
+__all__ = ["registry", "timeline", "export",
+           "Counter", "Gauge", "Timer", "Histogram",
+           "counter", "gauge", "timer", "histogram",
+           "snapshot", "report", "collect", "register_collector", "reset",
+           "remove",
+           "StepTimeline", "current", "peak_hbm_bytes_s", "set_step_cost",
+           "enabled", "telemetry_dir", "emit_event", "export_snapshot",
+           "render_prometheus", "read_events"]

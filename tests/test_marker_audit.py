@@ -163,6 +163,25 @@ def test_compile_cache_suite_stays_tier1():
         "warm-start subprocess pin is a round-10 acceptance criterion")
 
 
+def test_telemetry_suite_stays_tier1_with_chaos_marked():
+    """The telemetry suite is tier-1's only proof that the unified
+    report stays a superset of the six legacy report surfaces, that
+    snapshot-and-clear conserves concurrent writes, and that the
+    StepTimeline's phase attribution covers the measured step wall
+    time. It must (a) exist, (b) never carry a slow mark, and (c) mark
+    its kill-mid-rotation export drill ``chaos`` like the other
+    fault-injection suites."""
+    path = os.path.join(_TESTS, "test_telemetry.py")
+    assert os.path.exists(path), "tests/test_telemetry.py missing"
+    uses = _mark_uses()
+    assert "test_telemetry.py" not in uses.get("slow", set()), (
+        "test_telemetry.py must stay tier-1: the report-superset and "
+        "phase-attribution pins are round-11 acceptance criteria")
+    assert "test_telemetry.py" in uses.get("chaos", set()), (
+        "the telemetry_write kill-mid-rotation drill must carry "
+        "pytest.mark.chaos like the other fault-injection suites")
+
+
 def test_serving_fast_paths_stay_in_tier1():
     """Timing-SLO serving cases (throughput-efficiency pins) are
     ``slow``; everything functional — retrace pinning, shedding,

@@ -1,0 +1,321 @@
+#!/usr/bin/env python
+"""Operate on durable telemetry exports (MXTPU_TELEMETRY_DIR).
+
+The telemetry subsystem (``mxnet_tpu/telemetry/``) writes a rotating
+JSONL event log plus periodic full-report snapshots. This CLI is the
+operational surface:
+
+    telemetry.py tail    [--dir D] [-n N] [--json] [--kind K]
+    telemetry.py summary [--dir D] [--json]
+    telemetry.py diff    A.json B.json [--json]
+                         [--gate-bytes] [--tolerance PCT]
+    telemetry.py render  [--dir D]
+
+``tail`` prints the last N events across the rotated segments (a line
+torn by a mid-write kill is skipped and counted, never fatal — the
+log stays tailable after any crash); ``summary`` aggregates the whole
+event stream (train-step phase attribution, serving batches,
+checkpoint/compile events) plus the newest snapshot's headline gauges;
+``diff`` compares two snapshot files metric by metric — and with
+``--gate-bytes`` exits nonzero when ``step::bytes_accessed`` regressed
+between them: the r6 "strictly fewer bytes" pin generalized into the
+scriptable regression gate every fusion/pass PR runs (ROADMAP item 2);
+``render`` emits the newest snapshot in Prometheus text format for a
+scrape endpoint or textfile collector.
+
+Pure file-level operations: no accelerator backend is initialized.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+BYTES_METRIC = "step::bytes_accessed"
+
+
+def _dir(args):
+    d = args.dir or os.environ.get("MXTPU_TELEMETRY_DIR", "")
+    if not d:
+        sys.exit("no telemetry directory: pass --dir or set "
+                 "MXTPU_TELEMETRY_DIR")
+    return d
+
+
+def _read_events(directory):
+    from mxnet_tpu.telemetry.export import read_events
+    return read_events(directory)
+
+
+def _newest_snapshot(directory):
+    from mxnet_tpu.telemetry.export import snapshot_files
+    files = snapshot_files(directory)
+    return files[-1] if files else None
+
+
+def cmd_tail(args):
+    events, torn = _read_events(_dir(args))
+    if args.kind:
+        events = [e for e in events if e.get("kind") == args.kind]
+    events = events[-args.n:]
+    if torn:
+        print(f"(skipped {torn} torn line(s) — mid-write kill; "
+              "harmless)", file=sys.stderr)
+    for e in events:
+        if args.json:
+            print(json.dumps(e))
+        else:
+            ts = e.pop("ts", None)
+            kind = e.pop("kind", "?")
+            rest = " ".join(f"{k}={v}" for k, v in e.items())
+            print(f"{ts:.3f}  {kind:<16} {rest}" if ts
+                  else f"{kind:<16} {rest}")
+    return 0
+
+
+def _mean(vals):
+    return sum(vals) / len(vals) if vals else None
+
+
+def summarize(directory):
+    """Aggregate the event stream + newest snapshot into one dict
+    (the ``summary --json`` payload; tests round-trip through it)."""
+    events, torn = _read_events(directory)
+    kinds = {}
+    for e in events:
+        kinds[e.get("kind", "?")] = kinds.get(e.get("kind", "?"), 0) + 1
+    steps = [e for e in events if e.get("kind") == "train_step"]
+    serving = [e for e in events if e.get("kind") == "serving_batch"]
+    out = {
+        "dir": directory,
+        "events": len(events),
+        "torn_lines": torn,
+        "by_kind": kinds,
+    }
+    if steps:
+        phases = {}
+        for e in steps:
+            for name, secs in (e.get("phases") or {}).items():
+                phases.setdefault(name, []).append(float(secs))
+        last = steps[-1]
+        out["train"] = {
+            "milestones": len(steps),
+            "last_step": last.get("step"),
+            "mean_wall_s": round(_mean(
+                [float(e["wall_s"]) for e in steps
+                 if e.get("wall_s") is not None]) or 0.0, 6),
+            "mean_phase_s": {n: round(_mean(v), 6)
+                             for n, v in sorted(phases.items())},
+            "bytes_accessed": last.get("bytes_accessed"),
+            "flops": last.get("flops"),
+        }
+    if serving:
+        out["serving"] = {
+            "batches": len(serving),
+            "rows": sum(int(e.get("rows", 0)) for e in serving),
+            "requests": sum(int(e.get("requests", 0)) for e in serving),
+        }
+    snap_path = _newest_snapshot(directory)
+    if snap_path:
+        try:
+            with open(snap_path) as f:
+                snap = json.load(f)
+            metrics = snap.get("metrics", {})
+            headline = {}
+            for key in (BYTES_METRIC, "step::flops",
+                        "step::arithmetic_intensity_flop_b",
+                        "step::roofline_fraction"):
+                m = metrics.get(key)
+                if m is not None:
+                    headline[key] = m.get("value")
+            wall = metrics.get("step::wall_s")
+            if wall:
+                headline["step::wall_s.mean"] = wall.get("mean")
+                headline["step::wall_s.count"] = wall.get("count")
+            out["snapshot"] = {"path": snap_path, "headline": headline}
+        except (OSError, ValueError) as e:
+            out["snapshot"] = {"path": snap_path, "error": str(e)}
+    return out
+
+
+def cmd_summary(args):
+    out = summarize(_dir(args))
+    if args.json:
+        print(json.dumps(out, indent=1))
+        return 0
+    print(f"telemetry dir: {out['dir']}")
+    kinds = ", ".join(f"{k}={v}" for k, v in sorted(out["by_kind"].items()))
+    print(f"events: {out['events']} ({kinds})")
+    if out.get("torn_lines"):
+        print(f"torn lines skipped: {out['torn_lines']}")
+    tr = out.get("train")
+    if tr:
+        print(f"train: {tr['milestones']} milestone(s), last step "
+              f"{tr['last_step']}, mean wall {tr['mean_wall_s']}s")
+        for n, v in tr["mean_phase_s"].items():
+            print(f"  phase {n:<18} {v}s")
+        if tr.get("bytes_accessed"):
+            print(f"  bytes/step {tr['bytes_accessed']:.3e}")
+    sv = out.get("serving")
+    if sv:
+        print(f"serving: {sv['batches']} micro-batch(es), "
+              f"{sv['rows']} rows, {sv['requests']} requests")
+    sn = out.get("snapshot")
+    if sn:
+        print(f"newest snapshot: {sn['path']}")
+        for k, v in sn.get("headline", {}).items():
+            print(f"  {k} = {v}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# diff / bytes-accessed regression gate
+# ---------------------------------------------------------------------------
+def _load_bytes(tree, path):
+    """bytes-accessed-per-step from a snapshot (metrics gauge) or a
+    BENCH JSON (bench.py's ``xla_bytes_accessed_per_step``)."""
+    m = tree.get("metrics", {}).get(BYTES_METRIC)
+    if isinstance(m, dict) and m.get("value"):
+        return float(m["value"])
+    v = tree.get("xla_bytes_accessed_per_step")
+    if v:
+        return float(v)
+    t = tree.get("telemetry", {})
+    m = t.get("metrics", {}).get(BYTES_METRIC) if isinstance(t, dict) \
+        else None
+    if isinstance(m, dict) and m.get("value"):
+        return float(m["value"])
+    sys.exit(f"{path}: no {BYTES_METRIC} metric (and no "
+             "xla_bytes_accessed_per_step field) — not a telemetry "
+             "snapshot/BENCH file, or the run recorded no step costs")
+
+
+def _flat_values(tree):
+    """metric -> comparable scalar for the metric-by-metric diff."""
+    out = {}
+    for name, m in tree.get("metrics", {}).items():
+        if not isinstance(m, dict):
+            continue
+        if "value" in m:
+            out[name] = m["value"]
+        elif "count" in m:
+            out[name + ".count"] = m["count"]
+            if m.get("mean") is not None:
+                out[name + ".mean"] = m["mean"]
+    return out
+
+
+def cmd_diff(args):
+    trees = []
+    for path in (args.old, args.new):
+        try:
+            with open(path) as f:
+                trees.append(json.load(f))
+        except (OSError, ValueError) as e:
+            sys.exit(f"cannot read snapshot {path}: {e}")
+    old_t, new_t = trees
+    old_v, new_v = _flat_values(old_t), _flat_values(new_t)
+    changes = {}
+    for name in sorted(set(old_v) | set(new_v)):
+        a, b = old_v.get(name), new_v.get(name)
+        if a != b:
+            changes[name] = {"old": a, "new": b}
+    result = {"old": args.old, "new": args.new, "changed": changes}
+    gate_failed = False
+    if args.gate_bytes:
+        old_b = _load_bytes(old_t, args.old)
+        new_b = _load_bytes(new_t, args.new)
+        tol = args.tolerance / 100.0
+        bound = old_b * (1.0 + tol)
+        gate_failed = new_b > bound
+        result["gate_bytes"] = {
+            "old_bytes_per_step": old_b,
+            "new_bytes_per_step": new_b,
+            "delta_pct": round((new_b / old_b - 1.0) * 100.0, 4),
+            "tolerance_pct": args.tolerance,
+            "regressed": gate_failed,
+        }
+    if args.json:
+        print(json.dumps(result, indent=1))
+    else:
+        for name, c in changes.items():
+            print(f"{name}: {c['old']} -> {c['new']}")
+        if args.gate_bytes:
+            g = result["gate_bytes"]
+            print(f"bytes/step: {g['old_bytes_per_step']:.6g} -> "
+                  f"{g['new_bytes_per_step']:.6g} "
+                  f"({g['delta_pct']:+.3f}%, tolerance "
+                  f"{args.tolerance}%)")
+    if gate_failed:
+        print(f"BYTES REGRESSION: {BYTES_METRIC} grew "
+              f"{result['gate_bytes']['delta_pct']:+.3f}% (> "
+              f"{args.tolerance}% tolerance) — the step moves MORE "
+              "HBM bytes than the baseline snapshot; in the "
+              "bandwidth-bound regime that is a throughput regression "
+              "(ROADMAP item 2's currency). Fix the pass or re-baseline "
+              "deliberately.", file=sys.stderr)
+        return 2
+    if args.gate_bytes:
+        print("bytes gate OK", file=sys.stderr)
+    return 0
+
+
+def cmd_render(args):
+    snap_path = _newest_snapshot(_dir(args))
+    if not snap_path:
+        sys.exit("no snapshot-*.json in the telemetry directory")
+    with open(snap_path) as f:
+        snap = json.load(f)
+    from mxnet_tpu.telemetry.export import render_prometheus
+    sys.stdout.write(render_prometheus(snap.get("metrics", {})))
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Tail / summarize / diff durable telemetry exports")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("tail", help="print the last N events")
+    p.add_argument("--dir", default=None)
+    p.add_argument("-n", type=int, default=20)
+    p.add_argument("--kind", default=None,
+                   help="only events of this kind")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_tail)
+
+    p = sub.add_parser("summary",
+                       help="aggregate the event stream + newest snapshot")
+    p.add_argument("--dir", default=None)
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("diff",
+                       help="compare two snapshots; --gate-bytes fails "
+                            "on a bytes-accessed regression")
+    p.add_argument("old")
+    p.add_argument("new")
+    p.add_argument("--gate-bytes", action="store_true",
+                   help="exit 2 when step::bytes_accessed grew beyond "
+                        "--tolerance")
+    p.add_argument("--tolerance", type=float, default=0.0,
+                   help="allowed bytes growth in percent (default 0: "
+                        "strictly no more bytes)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_diff)
+
+    p = sub.add_parser("render",
+                       help="newest snapshot in Prometheus text format")
+    p.add_argument("--dir", default=None)
+    p.set_defaults(fn=cmd_render)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
