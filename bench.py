@@ -206,6 +206,63 @@ def main():
     except Exception:
         pass
 
+    # -- pass framework (round 12): per-pass decisions + serving BN-fold A/B -
+    # The fused step's pipeline report carries what each rewrite pass
+    # did (fired / skipped+reason / gate-rejected) and, for gated
+    # passes, the measured bytes delta. The serving A/B builds the
+    # SAME trained model into a Predictor with the BN constant-fold
+    # forced on vs off and compares the compiled bucket program's XLA
+    # bytes-accessed — the acceptance pin is folded strictly below.
+    pass_stats = None
+    try:
+        prep = getattr(model._fused, "pass_report", None)
+        pipeline = None
+        if prep:
+            pipeline = [{"pass": e["pass"], "status": e["status"],
+                         "sites": len(e["sites"]),
+                         "bytes_delta": e.get("bytes_delta"),
+                         "reason": e.get("reason")}
+                        for e in prep["passes"]]
+
+        def _serving_bytes(fold):
+            with mx.config.override("MXTPU_PASS_BN_FOLD",
+                                    "1" if fold else "0"):
+                pred = model.as_predictor(buckets=(8,))
+                pred.warmup()
+                by = float(pred.program_cost(8).get(
+                    "bytes accessed", 0.0))
+                applied = {e["pass"]: len(e["sites"])
+                           for e in pred.pass_report["passes"]
+                           if e["status"] == "applied"}
+            return (by or None), applied
+
+        by_fold, applied = _serving_bytes(True)
+        by_unfold, _ = _serving_bytes(False)
+        pass_stats = {
+            "fused_step_pipeline": pipeline,
+            "train_baseline_bytes": prep.get("baseline_bytes")
+            if prep else None,
+            "train_final_bytes": prep.get("final_bytes")
+            if prep else None,
+            "serving_bytes_bn_folded": by_fold,
+            "serving_bytes_unfolded": by_unfold,
+            "bn_fold_saving": round(1.0 - by_fold / by_unfold, 6)
+            if by_fold and by_unfold else None,
+            "bn_fold_sites": applied.get("bn_fold", 0),
+            "serving_pass_sites": applied,
+            "note": "symbol/passes/ pipeline (MXTPU_PASS_*): every "
+                    "pass's effect is measured XLA cost-analysis "
+                    "bytes-accessed and a pass that does not strictly "
+                    "reduce bytes is rejected at apply time "
+                    "(MXTPU_PASS_GATE_BYTES); serving_bytes_* compare "
+                    "the compiled bucket-8 predict program with the "
+                    "inference-time Conv->BN constant-fold on vs off "
+                    "(param-expression hoisting keeps the fold "
+                    "arithmetic out of the per-call program)",
+        }
+    except Exception:
+        pass
+
     peak = _peak_flops(dev)
     mfu = (model_flops_per_step / mean_step) / peak if peak else 0.0
     hw_util = ((xla_flops_per_step / mean_step) / peak
@@ -654,6 +711,9 @@ print("BENCH " + json.dumps({
         "hw_utilization": round(hw_util, 4) if hw_util else None,
         "xla_cost_flops_per_step": xla_flops_per_step,
         "xla_bytes_accessed_per_step": xla_bytes_per_step,
+        "arithmetic_intensity_flop_b": round(
+            xla_flops_per_step / xla_bytes_per_step, 3)
+        if xla_flops_per_step and xla_bytes_per_step else None,
         "fusion_sites": fusion_sites,
         "fusion_bailouts": fusion_bailouts,
         "fusion_flag": os.environ.get("MXTPU_PALLAS_FUSION", "auto"),
@@ -694,6 +754,7 @@ print("BENCH " + json.dumps({
         if host_decode_py else None,
         "host_decode_per_core": decode_core,
         "host_decode_cores": host_cores,
+        "passes": pass_stats,
         "resnet50_serving": serving_stats,
         "fault_tolerance": ft_stats,
         "input_pipeline": ip_stats,

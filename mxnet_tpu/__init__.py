@@ -32,6 +32,7 @@ from . import symbol
 from . import symbol as sym
 from .symbol import Symbol
 from .symbol.fusion import fusion_report
+from .symbol.passes import pass_report
 from . import executor
 from .executor import Executor
 from . import initializer

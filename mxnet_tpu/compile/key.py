@@ -179,14 +179,17 @@ def _canon(obj):
 
 def program_key(kind, name, *, symbol=None, symbol_sha=None,
                 input_sigs=(), optimizer=None, mesh=None, fusion=None,
-                extra=None):
+                passes=None, extra=None):
     """Build the canonical :class:`ProgramKey` for one entry point.
 
     ``input_sigs`` is any structural signature of the runtime inputs
     (shapes/dtypes); ``fusion`` the resolved fusion-flag material;
-    ``extra`` entry-point-specific trace inputs (guard flag, compute
-    dtype, metric slot signatures, compiler options...). Either
-    ``symbol`` or a precomputed ``symbol_sha`` identifies the graph.
+    ``passes`` the rewrite-pipeline fingerprint (per-pass flag/status/
+    site count from symbol/passes/manager.py — cached executables must
+    never mix pass regimes); ``extra`` entry-point-specific trace
+    inputs (guard flag, compute dtype, metric slot signatures, compiler
+    options...). Either ``symbol`` or a precomputed ``symbol_sha``
+    identifies the graph.
     """
     if symbol_sha is None and symbol is not None:
         symbol_sha = symbol_digest(symbol)
@@ -201,6 +204,7 @@ def program_key(kind, name, *, symbol=None, symbol_sha=None,
                        if mesh is not None and
                        not isinstance(mesh, dict) else mesh),
         "fusion": _canon(fusion),
+        "passes": _canon(passes),
         "backend": _backend_identity(),
         "extra": _canon(extra or {}),
     }

@@ -108,6 +108,28 @@ register("MXTPU_PALLAS_FUSION", "auto", str,
          "Graph-rewrite pass routing BN(+ReLU)->1x1-conv subgraphs "
          "through the Pallas fused kernel (symbol/fusion.py): 1/0 force "
          "on/off, auto = on for TPU backends, off elsewhere")
+register("MXTPU_PASS_RESIDUAL_FUSION", "auto", str,
+         "Graph-rewrite pass fusing BN(+ReLU)->conv chains of ANY "
+         "geometry onto the analytic-fused-backward composite op "
+         "(symbol/passes/residual_fusion.py): 1/0 force, auto = on for "
+         "TPU backends")
+register("MXTPU_PASS_BN_FOLD", "auto", str,
+         "Inference-time constant-fold of Conv->BN into the conv "
+         "weights/bias for eval-mode programs (Predictor / inference "
+         "executor; symbol/passes/bn_fold.py): 1/0 force, auto = on "
+         "for TPU backends")
+register("MXTPU_PASS_BF16", "auto", str,
+         "bf16 activation-traffic widening around convolutions with "
+         "fp32 master params (symbol/passes/bf16_cast.py): 1/0 force, "
+         "auto = on for TPU backends; skipped when the program already "
+         "runs a sub-f32 compute_dtype")
+register("MXTPU_PASS_GATE_BYTES", "auto", str,
+         "Measured bytes-accessed gate of the pass manager "
+         "(symbol/passes/manager.py): a pass that does not STRICTLY "
+         "reduce XLA cost-analysis bytes on the program it rewrote is "
+         "rejected at apply time. auto = gate auto-enabled passes, "
+         "trust explicitly forced ones; 1 = gate everything; 0 = trust "
+         "everything (no measurement compiles)")
 register("MXTPU_SERVING_BUCKETS", "1,8,64", str,
          "Default batch buckets for serving.Predictor: requests pad to "
          "the nearest bucket so arbitrary sizes never retrace")

@@ -200,6 +200,13 @@ class Executor:
         self._group2ctx = dict(group2ctx) if group2ctx else None
         self._device_map = None    # node -> device (group2ctx builds)
         self._fusion_report = None  # set by _build when the pass runs
+        self._pass_report = None   # full pipeline report (passes/)
+        # variable order of the graph the programs were TRACED from —
+        # passes may permute it (BN folding re-roots the fold
+        # arithmetic), so the jitted functions are fed in this order,
+        # never the original symbol's
+        self._run_arg_names = self.arg_names
+        self._run_aux_names = self.aux_names
 
     @property
     def arg_arrays(self):
@@ -272,28 +279,34 @@ class Executor:
                                            has_aux=True)
             return
 
-        # Pallas BN(+ReLU)→1×1-conv fusion (symbol/fusion.py, flag
-        # MXTPU_PALLAS_FUSION): the jitted functions are built from a
-        # rewritten graph; self._symbol stays the source of truth for
-        # names, serialization and the Monitor's tapped eager pass.
-        # Bound array shapes decide tile-divisibility bail-outs here.
-        # Multi-context (mesh) binds skip the pass: GSPMD cannot
-        # partition through the opaque Pallas custom call.
+        # Graph-rewrite pass pipeline (symbol/passes/): the jitted
+        # functions are built from a rewritten graph; self._symbol stays
+        # the source of truth for names, serialization and the Monitor's
+        # tapped eager pass. Bound array shapes decide applicability
+        # bail-outs here. Mesh binds no longer skip silently: the
+        # manager runs mesh-safe passes and counts the rest into
+        # passes::skipped with reason "mesh_bind".
         sym = self._symbol
         infer_only = all(r == "null" for r in self.grad_req.values())
-        if self._mesh is None:
-            from .symbol.fusion import maybe_fuse
-            shapes = {n: tuple(a.shape) for n, a in
-                      list(self.arg_dict.items()) +
-                      list(self.aux_dict.items())}
-            # inference-only binds (grad_req all 'null' — predict/score
-            # and serving executors) report under their own tag so
-            # fusion_report() shows the predict program is covered too
-            fused_sym, self._fusion_report = maybe_fuse(
-                self._symbol, shapes,
-                tag="executor_infer" if infer_only else "executor")
-            if fused_sym is not None:
-                sym = fused_sym
+        from .symbol import passes as _passes
+        shapes = {n: tuple(a.shape) for n, a in
+                  list(self.arg_dict.items()) +
+                  list(self.aux_dict.items())}
+        # inference-only binds (grad_req all 'null' — predict/score
+        # and serving executors) report under their own tag so
+        # pass/fusion reports show the predict program is covered too,
+        # and run in 'infer' mode so eval-only rewrites (BN folding)
+        # may fire
+        fused_sym, self._pass_report = _passes.apply_pipeline(
+            self._symbol, shapes,
+            tag="executor_infer" if infer_only else "executor",
+            mode="infer" if infer_only else "train", mesh=self._mesh)
+        self._fusion_report = _passes.legacy_fusion_entry(
+            self._pass_report)
+        if fused_sym is not None:
+            sym = fused_sym
+        self._run_arg_names = sym.list_arguments()
+        self._run_aux_names = sym.list_auxiliary_states()
         # route the bind through the compile registry: programs are
         # keyed by (symbol JSON, bound shapes/dtypes, grad_req, mesh,
         # fusion flag) and SHARED between executors with identical keys
@@ -321,12 +334,46 @@ class Executor:
             return compile_mod.program_key(
                 kind, f"{base}:{prog}", symbol_sha=symbol_sha,
                 input_sigs=sigs, mesh=self._mesh, fusion=fusion_mat,
+                passes=_passes.pipeline_key_material(self._pass_report),
                 extra={"prog": prog, "grad_req": grad_req_mat})
 
         key_fwd, key_grad = _key("fwd"), _key("grad")
+        orig_sym = self._symbol
 
         def _builder():
-            fwd, fwd_loss, loss_specs = build_graph_fns(sym)
+            fwd_run, fwd_loss_run, loss_specs = build_graph_fns(sym)
+            if infer_only and sym is not orig_sym:
+                # eval-only rewrites (BN folding bakes moving-stats
+                # semantics) are invalid under training=True; that
+                # (rare, debug) specialization of an inference bind —
+                # and its never-used grad program — trace the ORIGINAL
+                # graph, remapping the run-order feed back to it
+                fwd_orig, fwd_loss_orig, loss_specs = \
+                    build_graph_fns(orig_sym)
+                run_args, run_aux = (sym.list_arguments(),
+                                     sym.list_auxiliary_states())
+                orig_args = orig_sym.list_arguments()
+                orig_aux = orig_sym.list_auxiliary_states()
+
+                def _remap(vals, src, dst):
+                    m = dict(zip(src, vals))
+                    return tuple(m[n] for n in dst)
+
+                def fwd(arg_vals, aux_vals, key, training):
+                    if training:   # static arg: resolved at trace time
+                        return fwd_orig(
+                            _remap(arg_vals, run_args, orig_args),
+                            _remap(aux_vals, run_aux, orig_aux),
+                            key, True)
+                    return fwd_run(arg_vals, aux_vals, key, False)
+
+                def fwd_loss(arg_vals, aux_vals, head_grads, key):
+                    return fwd_loss_orig(
+                        _remap(arg_vals, run_args, orig_args),
+                        _remap(aux_vals, run_aux, orig_aux),
+                        head_grads, key)
+            else:
+                fwd, fwd_loss = fwd_run, fwd_loss_run
             return {
                 "fwd": compile_mod.JitProgram(fwd, key_fwd,
                                               static_argnums=(3,)),
@@ -367,10 +414,13 @@ class Executor:
             self._build()
         self._is_train = is_train
         from . import random as _random
+        # feed in the TRACED graph's variable order (_run_*: the pass
+        # pipeline may permute it); values come from the name-keyed
+        # dicts so the original symbol's lists stay the public surface
         arg_vals = tuple(self._place(n, self.arg_dict[n]._data)
-                         for n in self.arg_names)
+                         for n in self._run_arg_names)
         aux_vals = tuple(self._place(n, self.aux_dict[n]._data)
-                         for n in self.aux_names)
+                         for n in self._run_aux_names)
         cb_active = getattr(self._monitor_callback, "active",
                             None) if self._monitor_callback else None
         monitor_now = self._monitor_callback is not None and \
@@ -381,8 +431,8 @@ class Executor:
             # than the jit path — monitoring is a debug mode there too,
             # and an interval-based Monitor only activates it on its
             # monitored batches (callback.active probe)
-            amap = {n: v for n, v in zip(self.arg_names, arg_vals)}
-            amap.update(zip(self.aux_names, aux_vals))
+            amap = {n: v for n, v in zip(self._run_arg_names, arg_vals)}
+            amap.update(zip(self._run_aux_names, aux_vals))
             internals = {}
             outs, aux_updates = self._symbol.eval_arrays_ex(
                 amap, training=bool(is_train), rng_key=_random.next_key(),
@@ -414,9 +464,9 @@ class Executor:
         import jax.numpy as jnp
         from . import random as _random
         arg_vals = tuple(self._place(n, self.arg_dict[n]._data)
-                         for n in self.arg_names)
+                         for n in self._run_arg_names)
         aux_vals = tuple(self._place(n, self.aux_dict[n]._data)
-                         for n in self.aux_names)
+                         for n in self._run_aux_names)
         if out_grads is None:
             head_grads = None
         else:
@@ -429,7 +479,7 @@ class Executor:
             arg_vals, aux_vals, head_grads, _random.next_key())
         self.outputs = [_wrap(o) for o in outs]
         self._apply_aux_updates(aux_updates)
-        for name, g in zip(self.arg_names, grads):
+        for name, g in zip(self._run_arg_names, grads):
             req = self.grad_req.get(name, "null")
             if req == "null" or name not in self.grad_dict:
                 continue

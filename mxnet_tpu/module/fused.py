@@ -77,6 +77,13 @@ class FusedSymbolStep:
                         for n in self.param_names]
         _, self._fwd_loss, _ = build_graph_fns(symbol)
         self.fusion_report = None   # set by start() when the pass runs
+        self.pass_report = None     # full pipeline report (passes/)
+        self._passes_material = None  # pipeline fingerprint for keys
+        # traced graph's variable order (passes may permute it); the
+        # step program is fed in this order, buffers stay keyed by the
+        # original names
+        self._run_arg_names = self.arg_names
+        self._run_aux_names = self.aux_names
         from .. import random as _random
         self._base_key = _random.next_key()
         # non-finite step guard (MXTPU_FT_GUARD): NaN/Inf gradients
@@ -181,21 +188,26 @@ class FusedSymbolStep:
     def start(self, arg_dict, aux_dict):
         """Capture initial parameter/aux values (copies — our buffers get
         donated, the executor's must stay live for eval paths)."""
-        # Pallas BN(+ReLU)→1×1-conv fusion (symbol/fusion.py, flag
-        # MXTPU_PALLAS_FUSION): the whole-step program traces the
-        # rewritten graph; self.symbol stays authoritative for names.
-        # Deferred to start() because the tile-divisibility bail-outs
-        # need the bound array shapes. Mesh (multi-chip) steps skip the
-        # pass: GSPMD cannot partition through the opaque Pallas
-        # custom call.
-        if self.mesh is None:
-            from ..symbol.fusion import maybe_fuse
-            shapes = {n: tuple(d[n].shape)
-                      for d in (arg_dict, aux_dict) for n in d}
-            fused_sym, self.fusion_report = maybe_fuse(
-                self.symbol, shapes, tag="fused_step")
-            if fused_sym is not None:
-                _, self._fwd_loss, _ = build_graph_fns(fused_sym)
+        # Graph-rewrite pass pipeline (symbol/passes/): the whole-step
+        # program traces the rewritten graph; self.symbol stays
+        # authoritative for names. Deferred to start() because
+        # applicability bail-outs need the bound array shapes. Mesh
+        # (multi-chip) steps no longer skip silently: mesh-safe passes
+        # run, the rest count into passes::skipped ("mesh_bind").
+        from ..symbol import passes as _passes
+        shapes = {n: tuple(d[n].shape)
+                  for d in (arg_dict, aux_dict) for n in d}
+        fused_sym, self.pass_report = _passes.apply_pipeline(
+            self.symbol, shapes, tag="fused_step", mode="train",
+            mesh=self.mesh, compute_dtype=self.compute_dtype)
+        self.fusion_report = _passes.legacy_fusion_entry(
+            self.pass_report)
+        self._passes_material = _passes.pipeline_key_material(
+            self.pass_report)
+        if fused_sym is not None:
+            _, self._fwd_loss, _ = build_graph_fns(fused_sym)
+            self._run_arg_names = fused_sym.list_arguments()
+            self._run_aux_names = fused_sym.list_auxiliary_states()
         rep = self._rep_sharding()
 
         def _prep(v):
@@ -244,7 +256,7 @@ class FusedSymbolStep:
     def _build(self):
         fwd_loss = self._fwd_loss
         fopt = self._fopt
-        arg_names = self.arg_names
+        arg_names = self._run_arg_names   # traced graph's order
         big_pos = {n: i for i, n in enumerate(self._big_names)}
         small_off = self._small_off
         aux_big_pos = {n: i for i, n in enumerate(self._aux_big_names)}
@@ -254,7 +266,7 @@ class FusedSymbolStep:
         pidx = {n: i for i, n in enumerate(self.param_names)}
         lr_mults = [self._lr_mults[pidx[n]] for n in self._big_names]
         wd_eff = [self._wd_eff[pidx[n]] for n in self._big_names]
-        aux_names = self.aux_names
+        aux_names = self._run_aux_names   # traced graph's order
         has_flat = self._small_total > 0
         has_flat_aux = self._aux_total > 0
         flat_lrm = self._flat_lrm if has_flat else None
@@ -638,7 +650,7 @@ class FusedSymbolStep:
             "fused_step", f"fused_step:{self.symbol.name}",
             symbol_sha=self._symbol_sha, input_sigs=sig,
             optimizer=self.optimizer, mesh=self.mesh, fusion=fusion,
-            extra=extra)
+            passes=self._passes_material, extra=extra)
 
     def _acquire_program(self, sig, args):
         """Route one compile through the registry: AOT-load from the

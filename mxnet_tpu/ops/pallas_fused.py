@@ -383,6 +383,133 @@ def _fused_bn_conv_vjp(relu, batch_stats, fix_gamma, eps, interpret):
     return f
 
 
+# ---------------------------------------------------------------------------
+# the residual-chain graph op: BN(+ReLU)+conv of ANY geometry with the
+# same analytic fused backward (round 12's residual_fusion pass)
+# ---------------------------------------------------------------------------
+@functools.lru_cache(maxsize=None)
+def _fused_bn_convk_vjp(relu, batch_stats, fix_gamma, eps, stride, pad,
+                        dilate, groups):
+    """Whole-op custom VJP for the GENERAL conv case: (data, gamma,
+    beta, moving_mean, moving_var, w4 (O, C/g, kh, kw)) -> (out, mean,
+    var). The forward is the stock lax convolution over the normalized
+    activation (no Pallas kernel — arbitrary k×k/stride/pad geometries
+    don't tile like the 1×1 contraction), but the BACKWARD is the same
+    analytic fused BatchNorm backward as the 1×1 op: the normalized
+    activation is RECOMPUTED from raw residuals instead of stored
+    (dropping an activation-sized saved tensor per site — the bytes win
+    the pass manager's gate verifies), d(data) assembles in one
+    full-tensor pass, and the (C,)-sized dz moments come from one
+    variadic reduction. The conv half of the gradient goes through
+    XLA's own conv-grad lowering via ``jax.vjp``."""
+
+    def _conv(xhat, w4):
+        dn = jax.lax.conv_dimension_numbers(xhat.shape, w4.shape,
+                                            ("NCHW", "OIHW", "NCHW"))
+        return jax.lax.conv_general_dilated(
+            xhat, w4, stride, [(p, p) for p in pad], rhs_dilation=dilate,
+            dimension_numbers=dn, feature_group_count=groups)
+
+    def stats(x):
+        if batch_stats:
+            return jnp.mean(x, axis=(0, 2, 3)), jnp.var(x, axis=(0, 2, 3))
+        return None, None
+
+    def fold(gamma, beta, mean, var):
+        g = jnp.ones_like(gamma) if fix_gamma else gamma
+        scale = g * jax.lax.rsqrt(var + eps)
+        return g, scale, beta - mean * scale
+
+    def fwd(x, gamma, beta, mm, mv, w4):
+        mean, var = stats(x)
+        if mean is None:
+            mean, var = mm, mv
+        _, scale, shift = fold(gamma, beta, mean, var)
+        z = x * scale[:, None, None] + shift[:, None, None]
+        xhat = (jnp.maximum(z, 0.0) if relu else z).astype(x.dtype)
+        out = _conv(xhat, w4.astype(x.dtype)).astype(x.dtype)
+        return out, mean, var
+
+    @jax.custom_vjp
+    def f(x, gamma, beta, mm, mv, w4):
+        return fwd(x, gamma, beta, mm, mv, w4)
+
+    def f_fwd(x, gamma, beta, mm, mv, w4):
+        out, mean, var = fwd(x, gamma, beta, mm, mv, w4)
+        # raw-input residuals only: xhat recomputes in f_bwd (one
+        # elementwise pass instead of an activation-sized store)
+        return (out, mean, var), (x, gamma, beta, mean, var, w4)
+
+    def f_bwd(res, cts):
+        g_out, g_mean, g_var = cts
+        x, gamma, beta, mean, var, w4 = res
+        b, c, h, w_sp = x.shape
+        n = b * h * w_sp
+        g_eff, scale, shift = fold(gamma, beta, mean, var)
+        inv = jax.lax.rsqrt(var + eps)
+        z = x * scale[:, None, None] + shift[:, None, None]
+        xhat = (jnp.maximum(z, 0.0) if relu else z).astype(x.dtype)
+        _, conv_vjp = jax.vjp(_conv, xhat, w4.astype(x.dtype))
+        dxhat, dw4 = conv_vjp(g_out.astype(xhat.dtype))
+        dz = jnp.where(xhat > 0, dxhat, 0.0) if relu else dxhat
+        dzx = dz * x
+        s0, s1 = jax.lax.reduce(
+            (dz, dzx), (jnp.zeros((), dz.dtype), jnp.zeros((), dzx.dtype)),
+            lambda a, b: (a[0] + b[0], a[1] + b[1]), (0, 2, 3))
+        t = s1 - mean * s0
+        dbeta = s0.astype(beta.dtype)
+        dgamma = jnp.zeros_like(gamma) if fix_gamma \
+            else (t * inv).astype(gamma.dtype)
+        if batch_stats:
+            coef = g_eff * (inv ** 3) * t / n
+            cx = -coef + 2.0 * g_var / n
+            c0 = (-scale * s0 + coef * mean * n) / n + g_mean / n \
+                - 2.0 * mean * g_var / n
+            dx = (dz * scale[:, None, None] + x * cx[:, None, None]
+                  + c0[:, None, None]).astype(x.dtype)
+        else:
+            dx = (dz * scale[:, None, None]).astype(x.dtype)
+        return (dx, dgamma, dbeta, jnp.zeros_like(mean),
+                jnp.zeros_like(var), dw4.astype(w4.dtype))
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def _tup2(v, default):
+    if v is None:
+        return default
+    if isinstance(v, (int, float)):
+        return (int(v), int(v))
+    return tuple(int(x) for x in v)
+
+
+@register_op("_FusedBNReLUConvK", num_outputs=3)
+def fused_bn_relu_conv_general(data, gamma, beta, moving_mean, moving_var,
+                               weight, bias=None, eps=1e-3, momentum=0.9,
+                               fix_gamma=True, use_global_stats=False,
+                               act_type="relu", axis=1, kernel=None,
+                               stride=None, pad=None, dilate=None,
+                               num_filter=None, num_group=1, no_bias=True,
+                               training=False, **kw):
+    """BatchNorm -> [Activation(relu) ->] Convolution of ANY geometry as
+    ONE op with the analytic fused BN backward (internal; substituted by
+    symbol/passes/residual_fusion.py, never user-built). Mirrors
+    BatchNorm's (out, mean, var) output layout and (…, moving_mean,
+    moving_var) input positions 3/4 so the executors' running-aux fold
+    (Symbol._bn_aux_updates) applies unchanged; ``momentum`` is consumed
+    there, not here."""
+    batch_stats = bool(training) and not use_global_stats
+    out, mean, var = _fused_bn_convk_vjp(
+        act_type == "relu", batch_stats, bool(fix_gamma), float(eps),
+        _tup2(stride, (1, 1)), _tup2(pad, (0, 0)), _tup2(dilate, (1, 1)),
+        int(num_group or 1),
+    )(data, gamma, beta, moving_mean, moving_var, weight)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out.astype(data.dtype), mean, var
+
+
 @register_op("_FusedBNReLUConv", num_outputs=3)
 def fused_bn_relu_conv(data, gamma, beta, moving_mean, moving_var, weight,
                        bias=None, eps=1e-3, momentum=0.9, fix_gamma=True,

@@ -159,62 +159,96 @@ class Predictor:
         self._pvals = {n: _stage(arg_params[n], arg_shape_map[n], n)
                        for n in self.param_names
                        if n not in self._zero_args}
-        self._avals = tuple(_stage(aux_params[n], aux_shape_map[n], n)
-                            for n in aux_names)
 
-        # predict-program fusion (symbol/fusion.py): same rewrite the
-        # train step gets, applied to the inference graph; tile
-        # bail-outs use the largest-bucket bound shapes
+        # predict-program rewrite pipeline (symbol/passes/): the same
+        # fusion rewrites the train step gets, plus the serving-only BN
+        # constant-fold — eval-mode moving stats are constants, so
+        # matched Conv→BN BatchNorms disappear from the compiled
+        # predict program entirely. ``apply_fusion`` forces the pallas
+        # pass on/off; the other passes follow their MXTPU_PASS_*
+        # flags. Applicability uses the largest-bucket bound shapes.
+        import contextlib
         run_sym = symbol
         self.fusion_report = None
-        from ..symbol.fusion import fusion_enabled, maybe_fuse
-        if apply_fusion if apply_fusion is not None else fusion_enabled():
-            shapes = dict(arg_shape_map)
-            shapes.update(aux_shape_map)
-            with config.override("MXTPU_PALLAS_FUSION", "1"):
-                fused_sym, self.fusion_report = maybe_fuse(
-                    symbol, {n: tuple(s) for n, s in shapes.items()},
-                    tag="predictor")
-            if fused_sym is not None:
-                run_sym = fused_sym
+        self.pass_report = None
+        from ..symbol import passes as _passes
+        shapes = dict(arg_shape_map)
+        shapes.update(aux_shape_map)
+        force = contextlib.nullcontext()
+        if apply_fusion is not None:
+            force = config.override("MXTPU_PALLAS_FUSION",
+                                    "1" if apply_fusion else "0")
+        with force:
+            fused_sym, self.pass_report = _passes.apply_pipeline(
+                symbol, {n: tuple(s) for n, s in shapes.items()},
+                tag="predictor", mode="serving",
+                compute_dtype=self._cdt,
+                data_names=set(self.data_names) | set(self._zero_args))
+        self.fusion_report = _passes.legacy_fusion_entry(
+            self.pass_report)
+        self._passes_material = _passes.pipeline_key_material(
+            self.pass_report)
+        if fused_sym is not None:
+            run_sym = fused_sym
 
-        from ..executor import build_graph_fns
         from .. import compile as compile_mod
-        fwd, _, _ = build_graph_fns(run_sym)
+        from ..symbol.passes import hoist as _hoist
+        run_arg_names = run_sym.list_arguments()
+        run_aux_names = run_sym.list_auxiliary_states()
         self._arg_names = arg_names
         key = jax.random.PRNGKey(0)
         cdt = self._cdt
         zero_args = set(self._zero_args)
+        # parameter-expression hoisting (symbol/passes/hoist.py): a
+        # rewrite pass may leave weight-sized arithmetic in the graph
+        # (the BN fold's w·s, a bf16 weight cast). Frozen params make
+        # those subgraphs constants, so evaluate them ONCE here and
+        # feed the results as precomputed program arguments — the
+        # serving program reads the folded weight directly and the BN
+        # (plus its four parameter vectors) vanishes from the compiled
+        # program's byte traffic, not just its op count.
+        hoist_keys, live_vars = _hoist.hoist_plan(
+            run_sym, set(self.data_names) | zero_args)
+        staged_aux = {n: _stage(aux_params[n], aux_shape_map[n], n)
+                      for n in aux_names}
+        if hoist_keys:
+            amap = dict(self._pvals)
+            amap.update(staged_aux)
+            self._hvals = tuple(
+                jax.device_put(v)
+                for v in _hoist.hoist_values(run_sym, hoist_keys, amap))
+        else:
+            self._hvals = ()
+        hoist_ids = [(id(n), i) for n, i in hoist_keys]
         # parameters are explicit ARGUMENTS of the compiled program (in
-        # arg order), not closure constants: baked-in values would bloat
-        # every executable with the full weight set and — worse — let a
-        # persistent-cache hit replay stale weights. As arguments, the
-        # executable is weight-independent and the program key only
-        # covers shapes/dtypes.
-        self._pval_names = [n for n in arg_names
-                            if n in self._pvals]
+        # the traced graph's arg order), not closure constants: baked-in
+        # values would bloat every executable with the full weight set
+        # and — worse — let a persistent-cache hit replay stale weights.
+        # As arguments (hoisted values included: they recompute from the
+        # current params at staging), the executable is
+        # weight-independent and the program key only covers
+        # shapes/dtypes.
+        self._pval_names = [n for n in run_arg_names
+                            if n in self._pvals and n in live_vars]
         self._pvals_t = tuple(self._pvals[n] for n in self._pval_names)
         pval_names = list(self._pval_names)
+        live_aux_names = [n for n in run_aux_names if n in live_vars]
+        self._avals = tuple(staged_aux[n] for n in live_aux_names)
 
-        def infer_fn(pvals_t, data_vals, avals):
-            pmap = dict(zip(pval_names, pvals_t))
-            dmap = {}
+        def infer_fn(pvals_t, data_vals, avals, hvals):
+            amap = dict(zip(pval_names, pvals_t))
+            amap.update(zip(live_aux_names, avals))
+            bsz = data_vals[0].shape[0]
             for n, v in zip(self.data_names, data_vals):
                 if cdt is not None and v.dtype == jnp.float32:
                     v = v.astype(cdt)
-                dmap[n] = v
-            bsz = data_vals[0].shape[0]
-
-            def val(n):
-                if n in dmap:
-                    return dmap[n]
-                if n in zero_args:
-                    s = (bsz,) + tuple(arg_shape_map[n][1:])
-                    return jnp.zeros(s, jnp.float32)
-                return pmap[n]
-
-            outs, _ = fwd(tuple(val(n) for n in arg_names), avals, key,
-                          False)
+                amap[n] = v
+            for n in zero_args:
+                s = (bsz,) + tuple(arg_shape_map[n][1:])
+                amap[n] = jnp.zeros(s, jnp.float32)
+            outs, _ = run_sym.eval_arrays_ex(
+                amap, training=False, rng_key=key,
+                preset=dict(zip(hoist_ids, hvals)))
             return tuple(o.astype(jnp.float32)
                          if cdt is not None and o.dtype == cdt else o
                          for o in outs)
@@ -229,6 +263,7 @@ class Predictor:
         self._infer_jit = jax.jit(infer_fn, **donate)
         self._donate = bool(donate)
         self._programs = {}     # (bucket, dtypes) -> compiled program
+        self._program_costs = {}  # (bucket, dtypes) -> XLA cost dict
         self._materialized = 0  # fresh traces taken BY this instance
         self._cache_loads = 0   # bucket programs AOT-loaded from disk
         self._lock = threading.Lock()
@@ -286,11 +321,12 @@ class Predictor:
             "compute_dtype": str(self._cdt),
             "donate": self._donate,
             "zero_args": sorted(self._zero_args),
+            "hoisted": len(self._hvals),
         }
         return compile_mod.program_key(
             "predictor", f"predictor:{self.symbol.name}:b{bucket}",
             symbol_sha=self._symbol_sha, input_sigs=sigs, fusion=fusion,
-            extra=extra)
+            passes=self._passes_material, extra=extra)
 
     def _acquire_program(self, bucket, args):
         """One compiled program per (bucket, request dtypes), acquired
@@ -314,6 +350,7 @@ class Predictor:
             _fault.count("compile.aot_fallback")
             self._materialized += 1
             return self._infer_jit
+        self._note_cost(bucket, dtypes, exe)
         if source == "cache":
             self._cache_loads += 1
             jit_fn = self._infer_jit
@@ -325,6 +362,31 @@ class Predictor:
                 exe, jit_fn, "predictor", on_reject=_reject)
         self._materialized += 1
         return exe
+
+    def _note_cost(self, bucket, dtypes, exe):
+        """Record XLA cost analysis of an acquired bucket program
+        (bytes accessed is the serving-program currency too: the BN
+        constant-fold exists to shrink it). Best-effort — some
+        backends/AOT loads expose none."""
+        try:
+            cost = exe.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            self._program_costs[(bucket, dtypes)] = dict(cost) \
+                if cost else {}
+        except Exception:
+            self._program_costs[(bucket, dtypes)] = {}
+
+    def program_cost(self, bucket=None):
+        """XLA cost dict of one bucket's compiled program (largest
+        bucket by default; {} when not yet materialized or
+        unavailable). bench.py pins the BN-folded serving program's
+        bytes-accessed strictly below the unfolded one through here."""
+        b = self.buckets[-1] if bucket is None else bucket
+        for (bk, _dt), cost in self._program_costs.items():
+            if bk == b and cost:
+                return dict(cost)
+        return {}
 
     # -- execution ------------------------------------------------------------
     def _run_bucket(self, arrays, rows, bucket):
@@ -338,7 +400,8 @@ class Predictor:
                 a = np.concatenate([a, pad], axis=0)
             padded.append(jnp.asarray(a))
         with self._lock:
-            args = (self._pvals_t, tuple(padded), self._avals)
+            args = (self._pvals_t, tuple(padded), self._avals,
+                    self._hvals)
             pkey = (bucket, tuple(str(a.dtype) for a in padded))
             fn = self._programs.get(pkey)
             if fn is None:
@@ -427,6 +490,12 @@ class Predictor:
                     for b in self.buckets},
                 "fused_sites": len(self.fusion_report["sites"])
                 if self.fusion_report else 0,
+                "pass_sites": {
+                    e["pass"]: len(e["sites"])
+                    for e in (self.pass_report or {}).get("passes", ())
+                    if e["status"] == "applied"},
+                "bytes_accessed": float(self.program_cost().get(
+                    "bytes accessed", 0.0)) or None,
                 "compute_dtype": str(self._cdt) if self._cdt else None,
             }
             if reset:

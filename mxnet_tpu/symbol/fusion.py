@@ -56,10 +56,6 @@ from .symbol import Symbol, Group, _Node
 
 __all__ = ["fuse_symbol", "maybe_fuse", "fusion_enabled", "fusion_report"]
 
-# reports from rewrites performed this process, most recent last
-_REPORTS: List[dict] = []
-_MAX_REPORTS = 32
-
 
 def fusion_enabled() -> bool:
     """Resolve the MXTPU_PALLAS_FUSION flag: 1/0 force on/off, ``auto``
@@ -82,22 +78,14 @@ def _collect(reset: bool = False) -> dict:
     ``by_tag`` splits the site counts by which program was rewritten
     (``executor`` = train/grad builds, ``executor_infer`` = inference-
     only executor binds, ``fused_step`` = the whole-step train program,
-    ``predictor`` = serving predict programs)."""
-    reports = list(_REPORTS)
-    if reset:
-        # clear exactly what was read: a rewrite landing concurrently
-        # stays for the next window instead of vanishing unreported
-        del _REPORTS[:len(reports)]
-    by_tag: Dict[str, int] = {}
-    for r in reports:
-        by_tag[r.get("tag", "?")] = \
-            by_tag.get(r.get("tag", "?"), 0) + len(r["sites"])
-    return {
-        "num_rewritten_sites": sum(len(r["sites"]) for r in reports),
-        "num_bailouts": sum(len(r["bailouts"]) for r in reports),
-        "by_tag": by_tag,
-        "rewrites": reports,
-    }
+    ``predictor`` = serving predict programs).
+
+    Since round 12 this is a filtered VIEW of the pass framework's
+    record store (symbol/passes/manager.py — the same records back
+    ``pass_report()``); the payload shape and ``by_tag`` keys are
+    unchanged."""
+    from .passes.manager import collect_fusion
+    return collect_fusion(reset)
 
 
 from ..telemetry import registry as _treg  # noqa: E402
@@ -106,8 +94,12 @@ fusion_report = _treg.collector_view("fusion", _collect)
 
 
 def _record(report: dict):
-    _REPORTS.append(report)
-    del _REPORTS[:-_MAX_REPORTS]
+    """Register a standalone ``maybe_fuse`` rewrite in the shared pass
+    record store (the pipeline's own runs record through the manager)."""
+    from .passes.manager import record_legacy_fusion
+    tag = report.get("tag", "?")
+    status = "applied" if report.get("sites") else "no_match"
+    record_legacy_fusion(tag, report, status)
 
 
 def _attrs(node) -> dict:

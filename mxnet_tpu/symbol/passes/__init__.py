@@ -1,0 +1,48 @@
+"""``mxnet_tpu.symbol.passes`` — the graph-rewrite pass framework.
+
+Round 12 generalizes the one-off r6 fusion hook into a small compiler
+over the symbol graph: typed, composable, non-destructive rewrite
+passes (base.py) run as an ordered pipeline by a manager (manager.py)
+that skips inapplicable passes with counted reasons, validates every
+rewrite preserves the argument/aux name set, and — because the train
+step is HBM-bandwidth-bound and bytes are the currency — REJECTS any
+pass that does not strictly reduce XLA cost-analysis bytes-accessed on
+the program it rewrote (the measured-objective posture of TVM, and
+r6's "strictly fewer bytes" pin as a built-in invariant).
+
+Default pipeline (each pass behind its own env flag; 1/0 force,
+``auto`` = on-TPU):
+
+1. ``pallas_fusion`` (``MXTPU_PALLAS_FUSION``) — BN(+ReLU)→1×1-conv
+   onto the Pallas fused kernel (symbol/fusion.py's matcher, ported).
+2. ``residual_fusion`` (``MXTPU_PASS_RESIDUAL_FUSION``) — the rest of
+   the residual chain: BN(+ReLU)→conv of any geometry onto the
+   analytic-fused-backward composite op.
+3. ``bn_fold`` (``MXTPU_PASS_BN_FOLD``) — inference-time constant-fold
+   of Conv→BN into the conv weights/bias (the BN disappears from the
+   serving program).
+4. ``bf16_cast`` (``MXTPU_PASS_BF16``) — bf16 activation traffic
+   around convolutions, fp32 master params.
+
+``MXTPU_PASS_GATE_BYTES`` controls the measured gate (auto: gate
+auto-enabled passes, trust forced ones). ``pass_report()`` (telemetry
+collector ``passes``) reports every decision; ``fusion_report()``
+remains the legacy filtered view of the same store; ``tools/passes.py``
+dumps decisions for a symbol JSON and gates CI with ``--assert-bytes``.
+"""
+from .base import GraphPass, PassContext, rebuild_graph, resolve_flag, \
+    flag_active
+from .manager import (PassManager, apply_pipeline, default_manager,
+                      legacy_fusion_entry, measure_symbol_bytes,
+                      pass_report, pipeline_key_material)
+from .pallas_fusion import PallasFusionPass
+from .residual_fusion import ResidualFusionPass
+from .bn_fold import BNFoldPass
+from .bf16_cast import Bf16CastPass
+
+__all__ = ["GraphPass", "PassContext", "PassManager", "apply_pipeline",
+           "default_manager", "legacy_fusion_entry",
+           "measure_symbol_bytes", "pass_report",
+           "pipeline_key_material", "rebuild_graph", "resolve_flag",
+           "flag_active", "PallasFusionPass", "ResidualFusionPass",
+           "BNFoldPass", "Bf16CastPass"]

@@ -1,0 +1,183 @@
+"""Pass framework primitives: GraphPass, PassContext, shared rebuild.
+
+A pass is a typed, composable, non-destructive rewrite over the symbol
+graph (the Relay-style design of PAPERS.md applied to our Symbol DAG):
+it pattern-matches subgraphs, checks shape/dtype applicability, and
+returns a NEW graph sharing every untouched node — the executors keep
+the original symbol as the source of truth for naming, serialization
+and the Monitor's eager tap, and trace their compiled programs from the
+rewritten one. The pass manager (manager.py) owns ordering, per-pass
+env flags, mesh/mode applicability skips, and the measured
+bytes-accessed gate.
+
+Flag truth table (shared with the original MXTPU_PALLAS_FUSION
+semantics): ``1`` force on, ``0`` force off, ``auto`` = on when the
+default JAX backend is a TPU — off-TPU the rewrites run in
+interpret/stock-XLA mode, correct but not the point, so CPU runs opt in
+explicitly (tests and tools do).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+from ... import config
+from ..symbol import Symbol, Group, _Node
+
+__all__ = ["GraphPass", "PassContext", "resolve_flag", "flag_active",
+           "rebuild_graph", "parse_node_attrs"]
+
+
+def resolve_flag(value) -> str:
+    """Normalize an env-flag value to ``on`` / ``off`` / ``auto``."""
+    v = str(value).strip().lower()
+    if v in ("1", "true", "yes", "on"):
+        return "on"
+    if v in ("0", "false", "no", "off", ""):
+        return "off"
+    return "auto"
+
+def flag_active(resolved: str) -> bool:
+    """``auto`` resolves to on-for-TPU (the r6 fusion-pass convention:
+    off-TPU the kernels interpret — correct but slow — so CPU runs must
+    opt in explicitly)."""
+    if resolved == "on":
+        return True
+    if resolved == "off":
+        return False
+    import jax
+    return jax.default_backend() == "tpu"
+
+
+class PassContext:
+    """What the caller knows about the program being rewritten: the
+    entry point (``tag``), whether the program trains
+    (``mode`` = ``train`` / ``infer`` / ``serving``), the mesh (if the
+    bind is multi-device), and the runtime compute dtype (a step already
+    casting to bf16 must not be double-cast by the bf16 pass)."""
+
+    __slots__ = ("tag", "mode", "mesh", "compute_dtype", "shapes",
+                 "data_names")
+
+    def __init__(self, tag, mode="train", mesh=None, compute_dtype=None,
+                 shapes=None, data_names=None):
+        self.tag = tag
+        self.mode = mode
+        self.mesh = mesh
+        self.compute_dtype = compute_dtype
+        self.shapes = shapes or {}
+        # per-call inputs of a FROZEN program (serving): lets the bytes
+        # measurement apply the same parameter-expression hoisting the
+        # Predictor does, so the gate judges the program actually run
+        self.data_names = set(data_names) if data_names else None
+
+
+class GraphPass:
+    """One rewrite over the symbol graph.
+
+    Subclasses set ``name`` (report/telemetry identity), ``flag`` (the
+    controlling env var; None = always on), ``mesh_safe`` (False =
+    skipped, with a counted reason, on mesh binds — e.g. GSPMD cannot
+    partition an opaque Pallas custom call), and ``modes`` (which
+    program kinds the rewrite is valid for; e.g. BN folding bakes
+    moving-stats semantics so it only applies to eval-mode programs).
+
+    ``apply(sym, shapes, ctx)`` returns ``(new_sym | None, report)``
+    where ``report`` carries ``sites`` (what was rewritten) and
+    ``bailouts`` (per-site reasons the pattern did not fire). A pass
+    must be NON-destructive (share untouched nodes) and must preserve
+    the argument/auxiliary NAME SET — order may change (the executors
+    feed by the final graph's order), but a dropped or invented
+    variable is rejected by the manager.
+    """
+
+    name = "?"
+    flag: Optional[str] = None
+    default = "auto"
+    mesh_safe = False
+    modes = ("train", "infer", "serving")
+
+    def resolve(self) -> str:
+        """The pass's flag as ``on``/``off``/``auto``."""
+        if self.flag is None:
+            return "on"
+        return resolve_flag(config.get(self.flag, self.default))
+
+    def enabled(self) -> bool:
+        return flag_active(self.resolve())
+
+    def precheck(self, ctx: PassContext) -> Optional[str]:
+        """Context-level applicability; a non-None string is a skip
+        reason (counted in ``passes::skipped``)."""
+        return None
+
+    def apply(self, sym, shapes, ctx):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+def parse_node_attrs(node) -> dict:
+    """A node's user-visible attrs, parsed (strings from JSON round-trip
+    to values; ``__``-internal keys dropped)."""
+    from ...ops.registry import parse_attr
+    return {k: parse_attr(v) for k, v in node.attrs.items()
+            if not k.startswith("__")}
+
+
+def rebuild_graph(sym: Symbol, anchors: Dict[int, dict],
+                  build_anchor: Callable) -> Symbol:
+    """Non-destructive rebuild shared by the passes: returns a new
+    symbol sharing every node not reachable through an anchor rewrite.
+
+    ``anchors`` maps ``id(node)`` -> per-site match info; for each
+    anchored node the builder is called as ``build_anchor(node, site,
+    map_out, outmap)`` and must (a) construct its replacement subgraph
+    using ``map_out(parent, idx)`` for inputs, (b) register redirects
+    for the original node's outputs in ``outmap[(id(node), idx)] =
+    (new_node, new_idx)``, and (c) return the node standing in for the
+    anchor. Unanchored nodes copy structurally (same uid, so per-node
+    RNG salts stay aligned); untouched subgraphs are shared by
+    identity.
+    """
+    memo: Dict[int, _Node] = {}
+    outmap: Dict[tuple, tuple] = {}
+
+    def map_out(p, i):
+        if (id(p), i) in outmap:
+            return outmap[(id(p), i)]
+        n = build(p)
+        # build() may have been an anchor build that registered a
+        # redirect for exactly this output (e.g. the bf16 pass's
+        # back-to-f32 Cast); the consumer that TRIGGERED the build must
+        # honor it too, not wire to the bare replacement node
+        if (id(p), i) in outmap:
+            return outmap[(id(p), i)]
+        return n, i
+
+    def build(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if node.op is None:
+            memo[id(node)] = node
+            return node
+        if id(node) in anchors:
+            new = build_anchor(node, anchors[id(node)], map_out, outmap)
+            memo[id(node)] = new
+            return new
+        new_inputs = [map_out(p, i) for p, i in node.inputs]
+        if all(np_ is p and ni == i for (np_, ni), (p, i)
+               in zip(new_inputs, node.inputs)):
+            memo[id(node)] = node
+            return node
+        nn = _Node(node.op, node.name, attrs=node.attrs,
+                   inputs=new_inputs, num_outputs=node.num_outputs,
+                   user_attrs=node.user_attrs)
+        nn.uid = node.uid  # keep per-node RNG salts aligned
+        memo[id(node)] = nn
+        return nn
+
+    new_outs = []
+    for s in sym._output_symbols():
+        n2, i2 = map_out(s._node, s._out_index)
+        new_outs.append(Symbol(n2, i2))
+    if len(new_outs) == 1 and sym._group is None:
+        return new_outs[0]
+    return Group(new_outs)

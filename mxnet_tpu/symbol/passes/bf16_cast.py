@@ -1,0 +1,109 @@
+"""bf16 activation-traffic widening for allowlisted ops.
+
+In the bandwidth-bound regime a float32 activation tensor pays 4 bytes
+per element every time it crosses HBM; storing conv-adjacent
+activations in bf16 halves that traffic while fp32 MASTER parameters
+(and the fp32 optimizer state, gradients-at-rest, and BatchNorm
+statistics — everything numerically load-bearing) stay untouched. The
+pass wraps each eligible Convolution in ``Cast``s:
+
+    conv(x, w)  →  f32( conv(bf16(x), bf16(w)) )
+
+XLA fuses the input converts into the producer fusions (the
+intermediate is then WRITTEN as bf16, not converted after a f32
+store) and the output convert into the consumers; where the
+surrounding graph gives it nothing to fuse into, the converts cost
+more than they save — which is exactly what the pass manager's
+measured bytes gate exists to catch, so the pass proposes and the
+measurement decides.
+
+Allowlist: Convolution only (the MXU computes bf16 natively with f32
+accumulation). BatchNorm inputs stay f32 — each conv casts back up, so
+statistics never accumulate in bf16. The pass skips programs that
+already run a sub-f32 compute dtype (Module(compute_dtype="bfloat16")
+casts in-program; double-casting would UPCAST intermediates) and convs
+whose input is already explicitly cast to a non-f32 dtype.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from ..symbol import _Node
+from .base import GraphPass, parse_node_attrs, rebuild_graph
+
+__all__ = ["Bf16CastPass"]
+
+_CONV_OPS = ("Convolution", "Convolution_v1")
+
+
+class Bf16CastPass(GraphPass):
+    name = "bf16_cast"
+    flag = "MXTPU_PASS_BF16"
+    mesh_safe = True          # Casts partition like any elementwise op
+    modes = ("train", "infer", "serving")
+
+    def precheck(self, ctx):
+        if ctx.compute_dtype is not None and \
+                str(ctx.compute_dtype) not in ("float32", "None"):
+            return f"compute_dtype={ctx.compute_dtype}"
+        return None
+
+    def apply(self, sym, shapes, ctx):
+        import numpy as np
+        _, node_shapes = sym._propagate_shapes(dict(shapes))
+        sites: Dict[int, dict] = {}
+        report = {"sites": [], "bailouts": []}
+        for node in sym._topo_nodes():
+            if node.op not in _CONV_OPS:
+                continue
+            cattrs = parse_node_attrs(node)
+
+            def bail(reason):
+                report["bailouts"].append({"conv": node.name,
+                                           "reason": reason})
+
+            if "__input_names__" in node.attrs or \
+                    len(node.inputs) not in (2, 3):
+                bail("Convolution with non-standard inputs")
+                continue
+            dshape = node_shapes.get((id(node.inputs[0][0]),
+                                      node.inputs[0][1]))
+            if dshape is None or len(dshape) != 4:
+                bail(f"data shape unknown or not 4-D ({dshape})")
+                continue
+            src = node.inputs[0][0]
+            if src.op == "Cast":
+                sdt = parse_node_attrs(src).get("dtype", "float32")
+                if str(np.dtype(sdt)) != "float32":
+                    bail(f"input explicitly cast to {sdt} "
+                         "(mismatched dtype)")
+                    continue
+            sites[id(node)] = {"cattrs": cattrs}
+            report["sites"].append({"conv": node.name,
+                                    "data_shape": list(dshape)})
+        if not sites:
+            return None, report
+
+        def build_anchor(node, m, map_out, outmap):
+            def cast(inp, suffix, dtype):
+                return _Node("Cast", f"{node.name}__{suffix}",
+                             attrs={"dtype": dtype}, inputs=[inp])
+
+            new_inputs = [
+                (cast(map_out(*node.inputs[0]), "bf16_data",
+                      "bfloat16"), 0),
+                (cast(map_out(*node.inputs[1]), "bf16_weight",
+                      "bfloat16"), 0)]
+            if len(node.inputs) > 2:
+                new_inputs.append(
+                    (cast(map_out(*node.inputs[2]), "bf16_bias",
+                          "bfloat16"), 0))
+            conv = _Node(node.op, node.name, attrs=node.attrs,
+                         inputs=new_inputs, num_outputs=1,
+                         user_attrs=node.user_attrs)
+            conv.uid = node.uid
+            out = cast((conv, 0), "f32_out", "float32")
+            outmap[(id(node), 0)] = (out, 0)
+            return conv
+
+        return rebuild_graph(sym, sites, build_anchor), report

@@ -1,0 +1,172 @@
+"""Inference-time BN constant-folding: Convolution → BatchNorm sites.
+
+In an eval-mode program the BatchNorm's statistics are its moving
+averages — CONSTANT with respect to the data — so the whole
+normalization is an affine map of the conv output and folds exactly
+into the convolution's weights and bias:
+
+    BN(conv(x, w) + b) = conv(x, w·s) + (b − μ)·s + β,   s = γ/√(σ²+ε)
+
+The activation-sized normalize pass (read + write of the full conv
+output) disappears from the serving program entirely; what remains is
+a WEIGHT-sized multiply and a bias-sized affine, computed inside the
+program from the same parameter variables (the argument/aux sets are
+unchanged, so executors bind identically and a reloaded checkpoint
+still feeds the fold). This is the classic deploy-time BN fold the
+reference got from its Model Quantization/TensorRT-style exporters,
+done here as a graph pass so the Predictor's compiled program — and
+an inference-only executor's eval specialization — just never
+contains the BN.
+
+Applies to eval-mode programs (``serving`` / ``infer`` pipeline
+modes); in a training-mode pipeline it fires only for
+``use_global_stats`` BatchNorms, whose statistics are constants there
+too (gradients flow through the fold arithmetic exactly, and such BNs
+update no aux state). Mesh-safe: the rewrite is plain elementwise
+algebra GSPMD partitions like anything else.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from ..symbol import _Node
+from .base import GraphPass, parse_node_attrs, rebuild_graph
+
+__all__ = ["BNFoldPass"]
+
+_CONV_OPS = ("Convolution", "Convolution_v1")
+
+
+class BNFoldPass(GraphPass):
+    name = "bn_fold"
+    flag = "MXTPU_PASS_BN_FOLD"
+    mesh_safe = True
+    modes = ("train", "infer", "serving")
+
+    def apply(self, sym, shapes, ctx):
+        _, node_shapes = sym._propagate_shapes(dict(shapes))
+        nodes = sym._topo_nodes()
+        heads = {(id(s._node), s._out_index)
+                 for s in sym._output_symbols()}
+        uses: Dict[tuple, int] = {}
+        for n in nodes:
+            for p, i in n.inputs:
+                uses[(id(p), i)] = uses.get((id(p), i), 0) + 1
+
+        sites: Dict[int, dict] = {}
+        report = {"sites": [], "bailouts": []}
+        claimed = set()
+        for node in nodes:           # anchor: the BatchNorm node
+            if node.op not in ("BatchNorm", "BatchNorm_v1"):
+                continue
+            conv, conv_idx = node.inputs[0]
+            if conv_idx != 0 or conv.op not in _CONV_OPS or \
+                    id(conv) in claimed:
+                continue
+            battrs = parse_node_attrs(node)
+
+            def bail(reason):
+                report["bailouts"].append(
+                    {"conv": conv.name, "bn": node.name,
+                     "reason": reason})
+
+            if "__input_names__" in node.attrs or len(node.inputs) != 5:
+                bail("BatchNorm with non-standard inputs")
+                continue
+            if "__input_names__" in conv.attrs or \
+                    len(conv.inputs) not in (2, 3):
+                bail("Convolution with non-standard inputs")
+                continue
+            if int(battrs.get("axis", 1) or 1) != 1:
+                bail(f"BatchNorm axis={battrs.get('axis')} (need "
+                     "channel axis 1)")
+                continue
+            if ctx.mode == "train" and \
+                    not battrs.get("use_global_stats"):
+                # training programs recompute batch statistics; only a
+                # use_global_stats BN is a constant there
+                bail("batch statistics are not constant in a training "
+                     "program")
+                continue
+            k = (id(conv), 0)
+            if k in heads or uses.get(k, 0) != 1:
+                bail("conv output has other consumers — folding would "
+                     "duplicate the convolution")
+                continue
+            if any(uses.get((id(node), i), 0) or (id(node), i) in heads
+                   for i in (1, 2)):
+                bail("BatchNorm batch statistics are consumed in-graph")
+                continue
+            wshape = node_shapes.get((id(conv.inputs[1][0]),
+                                      conv.inputs[1][1]))
+            cattrs = parse_node_attrs(conv)
+            nf = cattrs.get("num_filter")
+            out_c = int(nf) if nf is not None else (
+                int(wshape[0]) if wshape else None)
+            if out_c is None:
+                bail("num_filter unknown")
+                continue
+            claimed.add(id(conv))
+            sites[id(node)] = {"conv": conv, "battrs": battrs,
+                               "cattrs": cattrs, "out_c": out_c}
+            report["sites"].append({
+                "conv": conv.name, "bn": node.name,
+                "num_filter": out_c})
+        if not sites:
+            return None, report
+
+        def build_anchor(bn, m, map_out, outmap):
+            conv = m["conv"]
+            battrs, cattrs = m["battrs"], m["cattrs"]
+            out_c = m["out_c"]
+            base = bn.name
+
+            def mk(op, suffix, inputs, attrs=None):
+                return _Node(op, f"{base}__fold_{suffix}",
+                             attrs=attrs or {},
+                             inputs=[(n, i) for n, i in inputs])
+
+            data_in = map_out(*conv.inputs[0])
+            w_in = map_out(*conv.inputs[1])
+            gamma_in = map_out(*bn.inputs[1])
+            beta_in = map_out(*bn.inputs[2])
+            mm_in = map_out(*bn.inputs[3])
+            mv_in = map_out(*bn.inputs[4])
+            # s = γ_eff / sqrt(σ² + ε); fix_gamma BNs normalize with γ=1
+            # but γ must STAY a graph input (dropping it would change
+            # the argument set), so γ_eff = 0·γ + 1 there
+            inv = mk("rsqrt", "inv",
+                     [(mk("_plus_scalar", "vareps", [mv_in],
+                          {"scalar": battrs.get("eps", 1e-3)}), 0)])
+            if battrs.get("fix_gamma", True):
+                g0 = mk("_mul_scalar", "g0", [gamma_in], {"scalar": 0.0})
+                geff = mk("_plus_scalar", "g1", [(g0, 0)],
+                          {"scalar": 1.0})
+                scale = mk("broadcast_mul", "scale",
+                           [(geff, 0), (inv, 0)])
+            else:
+                scale = mk("broadcast_mul", "scale",
+                           [gamma_in, (inv, 0)])
+            wscale = mk("Reshape", "wscale", [(scale, 0)],
+                        {"shape": (out_c, 1, 1, 1)})
+            w2 = mk("broadcast_mul", "w", [w_in, (wscale, 0)])
+            # b' = β + (b − μ)·s   (β − μ·s without a conv bias)
+            no_bias = bool(cattrs.get("no_bias", False))
+            if len(conv.inputs) > 2 and not no_bias:
+                b_in = map_out(*conv.inputs[2])
+                t = mk("broadcast_sub", "bm", [b_in, mm_in])
+                ts = mk("broadcast_mul", "bms", [(t, 0), (scale, 0)])
+                b2 = mk("broadcast_add", "bias", [beta_in, (ts, 0)])
+            else:
+                ms = mk("broadcast_mul", "ms", [mm_in, (scale, 0)])
+                b2 = mk("broadcast_sub", "bias", [beta_in, (ms, 0)])
+            attrs = dict(conv.attrs)
+            attrs["no_bias"] = False
+            folded = _Node(conv.op, f"{conv.name}__bnfold", attrs=attrs,
+                           inputs=[data_in, (w2, 0), (b2, 0)],
+                           num_outputs=1, user_attrs=conv.user_attrs)
+            folded.uid = conv.uid
+            outmap[(id(bn), 0)] = (folded, 0)
+            return folded
+
+        return rebuild_graph(sym, sites, build_anchor), report

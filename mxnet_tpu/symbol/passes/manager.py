@@ -1,0 +1,449 @@
+"""Pass manager: ordered pipeline + measured bytes-accessed gate.
+
+The step is HBM-bandwidth-bound (BENCH_r05: ~114% of the v5e roofline,
+arithmetic intensity ~33 FLOP/B vs the ridge of 240), so bytes moved is
+the optimization currency and every rewrite must EARN its place by
+measurement, in the spirit of TVM's measurement-driven optimization
+(PAPERS.md). The manager runs the registered passes in order over a
+symbol graph and, for each pass that fired, lowers + compiles the
+program proxy before and after the rewrite and reads XLA cost
+analysis's "bytes accessed": a pass that does not STRICTLY reduce
+bytes on the program it rewrote is rejected at apply time — r6's
+"strictly fewer bytes" test pin and r11's ``tools/telemetry.py diff
+--gate-bytes`` generalized into the framework's built-in invariant.
+
+Gating (``MXTPU_PASS_GATE_BYTES``): ``auto`` (default) measures and
+gates passes that auto-enabled, and trusts passes the user explicitly
+forced on (``<flag>=1`` means "I want this rewrite" — and keeps the
+measurement compiles off the test/CI hot path); ``1`` measures and
+gates everything; ``0`` trusts everything. Measurements are memoized
+per (graph, shapes, mode) so an unchanged graph is never re-lowered.
+
+Every decision is observable: per-pass ``passes::<name>::bytes_delta``
+/ ``::sites`` metrics, ``passes::applied`` / ``rejected`` / ``skipped``
+(+ per-reason) counters — mesh-bind skips are COUNTED with a reason,
+not silently dropped per-site like the r6 hook — and ``pass_report()``
+(telemetry collector ``passes``) carries the full pipeline records.
+``fusion_report()`` remains the legacy-compatible filtered view of the
+same store (symbol/fusion.py delegates here).
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ... import config
+from ...telemetry import registry as _treg
+from .base import GraphPass, PassContext, flag_active
+
+__all__ = ["PassManager", "default_manager", "apply_pipeline",
+           "pass_report", "legacy_fusion_entry", "pipeline_key_material",
+           "measure_symbol_bytes", "collect_fusion"]
+
+# pipeline records, most recent last (shared by pass_report and the
+# legacy fusion_report view; each view consumes independently via its
+# own seen-flag so their reset semantics stay per-surface)
+_RECORDS: List[dict] = []
+_MAX_RECORDS = 64
+_LOCK = threading.RLock()
+
+# (graph digest, shapes, mode) -> measured bytes-accessed
+_MEASURE_MEMO: Dict[tuple, Optional[float]] = {}
+_MEASURE_MEMO_MAX = 128
+
+
+def _record(report: dict):
+    with _LOCK:
+        _RECORDS.append(report)
+        del _RECORDS[:-_MAX_RECORDS]
+
+
+def record_legacy_fusion(tag: str, rep: dict, status: str):
+    """Entry point for symbol/fusion.py's standalone ``maybe_fuse``:
+    its rewrites land in the same store the pipeline fills, so
+    fusion_report()/pass_report() cover direct callers too."""
+    _record({
+        "tag": tag, "mode": "?",
+        "passes": [{"pass": "pallas_fusion", "flag": "on",
+                    "status": status, "sites": rep.get("sites", []),
+                    "bailouts": rep.get("bailouts", [])}],
+        "baseline_bytes": None, "final_bytes": None,
+        "_seen": {"passes": False, "fusion": False},
+    })
+
+
+# ---------------------------------------------------------------------------
+# bytes measurement (the gate's objective function)
+# ---------------------------------------------------------------------------
+def measure_symbol_bytes(sym, shapes, mode="train", data_names=None):
+    """XLA cost-analysis "bytes accessed" of the program proxy for
+    ``sym``: the jitted forward (eval mode) for ``infer``/``serving``
+    programs, the jitted implicit-loss gradient program for ``train``
+    (the backward is where the analytic-VJP fusion savings live, so a
+    train-mode gate must see it). With ``data_names`` (serving), the
+    proxy applies the Predictor's parameter-expression hoisting
+    (hoist.py) so the gate judges the frozen program actually run, not
+    one that re-evaluates weight-constant arithmetic per call. Returns
+    None when the backend exposes no cost analysis — the gate then
+    counts the pass ``unmeasured`` instead of guessing. Memoized per
+    (graph JSON, shapes, mode, hoist set)."""
+    kind = "train" if mode == "train" else "infer"
+    try:
+        digest = hashlib.sha256(sym.tojson().encode("utf-8")).hexdigest()
+        key = (digest,
+               tuple(sorted((n, tuple(s)) for n, s in shapes.items())),
+               kind, tuple(sorted(data_names)) if data_names else None)
+    except Exception:
+        key = None
+    if key is not None:
+        with _LOCK:
+            if key in _MEASURE_MEMO:
+                return _MEASURE_MEMO[key]
+    val = _measure(sym, shapes, kind, data_names)
+    if key is not None:
+        with _LOCK:
+            if len(_MEASURE_MEMO) >= _MEASURE_MEMO_MAX:
+                _MEASURE_MEMO.clear()
+            _MEASURE_MEMO[key] = val
+    return val
+
+
+def _measure(sym, shapes, kind, data_names=None):
+    import numpy as np
+    try:
+        import jax
+        from ...executor import build_graph_fns
+        arg_names = sym.list_arguments()
+        aux_names = sym.list_auxiliary_states()
+        if any(n not in shapes for n in arg_names + aux_names):
+            return None
+
+        def sds(n):
+            return jax.ShapeDtypeStruct(tuple(shapes[n]), np.float32)
+
+        if kind == "infer" and data_names:
+            from .hoist import hoist_plan, hoist_values
+            keys, live = hoist_plan(sym, data_names)
+            names = [n for n in arg_names + aux_names
+                     if n in data_names or n in live]
+            hstructs = jax.eval_shape(
+                lambda m: hoist_values(sym, keys, m),
+                {n: sds(n) for n in arg_names + aux_names
+                 if n not in data_names}) if keys else ()
+            hoist_ids = [(id(n), i) for n, i in keys]
+
+            def fn(vals, hvals, key):
+                amap = dict(zip(names, vals))
+                outs, _ = sym.eval_arrays_ex(
+                    amap, training=False, rng_key=key,
+                    preset=dict(zip(hoist_ids, hvals)))
+                return tuple(outs)
+
+            lowered = jax.jit(fn).lower(
+                tuple(sds(n) for n in names), tuple(hstructs),
+                jax.random.PRNGKey(0))
+        else:
+            arg_s = tuple(sds(n) for n in arg_names)
+            aux_s = tuple(sds(n) for n in aux_names)
+            fwd, fwd_loss, _ = build_graph_fns(sym)
+            if kind == "train":
+                def fn(arg_vals, aux_vals, key):
+                    return jax.grad(fwd_loss, argnums=0, has_aux=True)(
+                        arg_vals, aux_vals, None, key)
+            else:
+                def fn(arg_vals, aux_vals, key):
+                    return fwd(arg_vals, aux_vals, key, False)
+            lowered = jax.jit(fn).lower(arg_s, aux_s,
+                                        jax.random.PRNGKey(0))
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        cost = dict(cost) if cost else {}
+        by = float(cost.get("bytes accessed", 0.0) or 0.0)
+        return by if by > 0 else None
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the manager
+# ---------------------------------------------------------------------------
+class PassManager:
+    """An ordered pipeline of :class:`GraphPass` instances."""
+
+    def __init__(self, passes: List[GraphPass]):
+        self.passes = list(passes)
+
+    def run(self, sym, shapes, *, tag, mode="train", mesh=None,
+            compute_dtype=None, data_names=None
+            ) -> Tuple[Optional[object], dict]:
+        """Run the pipeline over ``sym``. ``shapes`` maps every
+        argument AND aux name to its bound shape (applicability checks
+        and the bytes proxy both need concrete shapes). Returns
+        ``(final_sym | None, report)`` — None means no pass survived
+        and callers keep the original graph."""
+        shapes = {n: tuple(s) for n, s in shapes.items()}
+        ctx = PassContext(tag=tag, mode=mode, mesh=mesh,
+                          compute_dtype=compute_dtype, shapes=shapes,
+                          data_names=data_names)
+        gate = str(config.get("MXTPU_PASS_GATE_BYTES", "auto")
+                   ).strip().lower()
+        report = {"tag": tag, "mode": mode, "passes": [],
+                  "baseline_bytes": None, "final_bytes": None,
+                  "_seen": {"passes": False, "fusion": False}}
+        cur = sym
+        changed = False
+        cur_bytes = None
+        for p in self.passes:
+            flag = p.resolve()
+            entry = {"pass": p.name, "flag": flag, "status": "?",
+                     "reason": None, "sites": [], "bailouts": [],
+                     "bytes_before": None, "bytes_after": None,
+                     "bytes_delta": None}
+            report["passes"].append(entry)
+            if not flag_active(flag):
+                entry["status"] = "disabled"
+                continue
+            if mesh is not None and not p.mesh_safe:
+                self._skip(entry, p, "mesh_bind")
+                continue
+            if mode not in p.modes:
+                # structural inapplicability (e.g. BN folding on a
+                # training program) — reported, but not a "skip" in the
+                # counted, something-was-missed sense
+                entry["status"] = "inapplicable"
+                entry["reason"] = f"mode:{mode}"
+                continue
+            reason = p.precheck(ctx)
+            if reason:
+                self._skip(entry, p, reason)
+                continue
+            try:
+                new_sym, prep = p.apply(cur, shapes, ctx)
+            except Exception as e:  # a broken pass must not break binds
+                entry["status"] = "error"
+                entry["reason"] = repr(e)
+                _treg.counter("passes::errors").inc()
+                continue
+            entry["sites"] = list(prep.get("sites", ()))
+            entry["bailouts"] = list(prep.get("bailouts", ()))
+            if new_sym is None or not entry["sites"]:
+                entry["status"] = "no_match"
+                continue
+            if (set(new_sym.list_arguments()) != set(cur.list_arguments())
+                    or set(new_sym.list_auxiliary_states())
+                    != set(cur.list_auxiliary_states())):
+                # a pass may permute the variable order (executors feed
+                # by the final graph's order) but never change the SET —
+                # a dropped variable would silently unbind a parameter
+                self._reject(entry, p,
+                             "rewrite changed the argument/aux name set")
+                continue
+            measure = gate == "1" or (gate not in ("0", "false", "off")
+                                      and flag == "auto")
+            if measure:
+                if cur_bytes is None:
+                    cur_bytes = measure_symbol_bytes(
+                        cur, shapes, mode, data_names=ctx.data_names)
+                    if report["baseline_bytes"] is None:
+                        report["baseline_bytes"] = cur_bytes
+                new_bytes = measure_symbol_bytes(
+                    new_sym, shapes, mode, data_names=ctx.data_names) \
+                    if cur_bytes is not None else None
+                if cur_bytes is None or new_bytes is None:
+                    _treg.counter("passes::unmeasured").inc()
+                else:
+                    entry["bytes_before"] = cur_bytes
+                    entry["bytes_after"] = new_bytes
+                    entry["bytes_delta"] = new_bytes - cur_bytes
+                    _treg.gauge(f"passes::{p.name}::bytes_delta").set(
+                        new_bytes - cur_bytes)
+                    if new_bytes >= cur_bytes:
+                        self._reject(
+                            entry, p,
+                            f"bytes not strictly reduced "
+                            f"({cur_bytes:.0f} -> {new_bytes:.0f})")
+                        continue
+                    cur_bytes = new_bytes
+            entry["status"] = "applied"
+            _treg.counter("passes::applied").inc()
+            _treg.counter(f"passes::{p.name}::sites").inc(
+                len(entry["sites"]))
+            cur = new_sym
+            changed = True
+        report["final_bytes"] = cur_bytes
+        # an all-disabled pipeline (the common CPU default) records
+        # nothing — reports would otherwise drown in no-op entries from
+        # every bind; any enabled pass (fired or not, skipped, or
+        # rejected) makes the run reportable
+        if any(e["status"] != "disabled" for e in report["passes"]):
+            _record(report)
+        return (cur if changed else None), report
+
+    @staticmethod
+    def _skip(entry, p, reason):
+        entry["status"] = "skipped"
+        entry["reason"] = reason
+        _treg.counter("passes::skipped").inc()
+        _treg.counter(f"passes::skipped::{reason}").inc()
+
+    @staticmethod
+    def _reject(entry, p, reason):
+        entry["status"] = "rejected"
+        entry["reason"] = reason
+        _treg.counter("passes::rejected").inc()
+        _treg.counter(f"passes::rejected::{p.name}").inc()
+
+
+_default = [None]
+
+
+def default_manager() -> PassManager:
+    """The process-wide pipeline, in order: Pallas BN(+ReLU)→1×1-conv
+    fusion (r6's pass, ported), residual-chain fusion (BN(+ReLU)→conv
+    of any geometry onto the analytic-backward composite op),
+    inference-time BN constant-folding, bf16 activation-traffic
+    widening."""
+    if _default[0] is None:
+        from .pallas_fusion import PallasFusionPass
+        from .residual_fusion import ResidualFusionPass
+        from .bn_fold import BNFoldPass
+        from .bf16_cast import Bf16CastPass
+        _default[0] = PassManager([PallasFusionPass(),
+                                   ResidualFusionPass(),
+                                   BNFoldPass(),
+                                   Bf16CastPass()])
+    return _default[0]
+
+
+def apply_pipeline(sym, shapes, *, tag, mode="train", mesh=None,
+                   compute_dtype=None, data_names=None):
+    """Executor entry point: run the default pipeline (see
+    :func:`default_manager`) over a bound symbol."""
+    return default_manager().run(sym, shapes, tag=tag, mode=mode,
+                                 mesh=mesh, compute_dtype=compute_dtype,
+                                 data_names=data_names)
+
+
+def pipeline_key_material(report) -> Optional[list]:
+    """The pipeline's contribution to a compiled program's cache key:
+    per-pass (name, resolved flag, status, rewritten-site count). Two
+    builds that resolved the pipeline differently — a flag flipped, a
+    pass fired on one and not the other, the gate rejected one — are
+    different programs and must never share a cached executable."""
+    if not report:
+        return None
+    return [(e["pass"], e["flag"], e.get("status"),
+             len(e.get("sites") or ()))
+            for e in report["passes"]]
+
+
+def legacy_fusion_entry(report) -> Optional[dict]:
+    """The pallas-fusion slice of a pipeline report, in the legacy
+    ``maybe_fuse`` report shape ({tag, sites, bailouts}) the executors
+    expose as ``_fusion_report`` / ``fusion_report`` attributes. None
+    when the pass was disabled (the legacy 'pass did not run'
+    signal)."""
+    if not report:
+        return None
+    for e in report["passes"]:
+        if e["pass"] != "pallas_fusion":
+            continue
+        if e["status"] == "disabled":
+            return None
+        out = {"tag": report["tag"], "sites": list(e["sites"]),
+               "bailouts": list(e["bailouts"])}
+        if e["status"] == "rejected":
+            out["bailouts"] = out["bailouts"] + [{
+                "conv": None, "bn": None,
+                "reason": f"rewrite rejected: {e['reason']}"}]
+            out["sites"] = []
+        return out
+    return None
+
+
+# ---------------------------------------------------------------------------
+# report surfaces
+# ---------------------------------------------------------------------------
+def _collect_passes(reset: bool = False) -> dict:
+    """The ``passes`` telemetry collector: per-pass aggregates (sites,
+    summed bytes delta), per-tag site counts (same stable tag keys as
+    the legacy fusion report: ``executor``, ``executor_infer``,
+    ``fused_step``, ``predictor``), counted skips with reasons, and the
+    raw pipeline records."""
+    with _LOCK:
+        recs = [r for r in _RECORDS if not r["_seen"]["passes"]]
+        if reset:
+            for r in recs:
+                r["_seen"]["passes"] = True
+    by_pass: Dict[str, dict] = {}
+    by_tag: Dict[str, int] = {}
+    skipped: Dict[tuple, int] = {}
+    n_applied = n_rejected = n_skipped = 0
+    for r in recs:
+        for e in r["passes"]:
+            agg = by_pass.setdefault(e["pass"], {
+                "applied": 0, "rejected": 0, "skipped": 0, "sites": 0,
+                "bytes_delta": 0.0, "measured": 0})
+            if e["status"] == "applied":
+                n_applied += 1
+                agg["applied"] += 1
+                agg["sites"] += len(e["sites"])
+                by_tag[r["tag"]] = by_tag.get(r["tag"], 0) + \
+                    len(e["sites"])
+                if e.get("bytes_delta") is not None:
+                    agg["bytes_delta"] += e["bytes_delta"]
+                    agg["measured"] += 1
+            elif e["status"] == "rejected":
+                n_rejected += 1
+                agg["rejected"] += 1
+            elif e["status"] == "skipped":
+                n_skipped += 1
+                agg["skipped"] += 1
+                k = (e["pass"], r["tag"], e.get("reason"))
+                skipped[k] = skipped.get(k, 0) + 1
+    public = [{k: v for k, v in r.items() if k != "_seen"}
+              for r in recs]
+    return {
+        "num_applied": n_applied,
+        "num_rejected": n_rejected,
+        "num_skipped": n_skipped,
+        "by_pass": by_pass,
+        "by_tag": by_tag,
+        "skipped": [{"pass": p, "tag": t, "reason": why, "count": c}
+                    for (p, t, why), c in sorted(skipped.items(),
+                                                 key=lambda kv: kv[0])],
+        "pipelines": public,
+    }
+
+
+pass_report = _treg.collector_view("passes", _collect_passes)
+
+
+def collect_fusion(reset: bool = False) -> dict:
+    """The legacy ``fusion_report()`` payload, built from the SAME
+    store as :func:`pass_report` (satellite of round 12: the fusion
+    report is a compatible filtered view — same ``by_tag`` keys, same
+    per-rewrite {tag, sites, bailouts} entries)."""
+    with _LOCK:
+        recs = [r for r in _RECORDS if not r["_seen"]["fusion"]]
+        if reset:
+            for r in recs:
+                r["_seen"]["fusion"] = True
+    rewrites = []
+    for r in recs:
+        for e in r["passes"]:
+            if e["pass"] != "pallas_fusion" or e["status"] == "disabled":
+                continue
+            rewrites.append({"tag": r["tag"], "sites": list(e["sites"]),
+                             "bailouts": list(e["bailouts"])})
+    by_tag: Dict[str, int] = {}
+    for r in rewrites:
+        by_tag[r["tag"]] = by_tag.get(r["tag"], 0) + len(r["sites"])
+    return {
+        "num_rewritten_sites": sum(len(r["sites"]) for r in rewrites),
+        "num_bailouts": sum(len(r["bailouts"]) for r in rewrites),
+        "by_tag": by_tag,
+        "rewrites": rewrites,
+    }

@@ -1,0 +1,27 @@
+"""The r6 Pallas fusion pass, ported onto the pass framework.
+
+The matcher/rewriter lives unchanged in ``symbol/fusion.py``
+(``fuse_symbol``): BN(+ReLU)→1×1-conv subgraphs substitute the
+``_FusedBNReLUConv`` Pallas op, with shape-aware tile bail-outs. This
+class is its framework adapter: flag resolution stays on the legacy
+``MXTPU_PALLAS_FUSION`` env var, and mesh binds SKIP (counted by the
+manager — GSPMD cannot partition the opaque Pallas custom call, ROADMAP
+item 1).
+"""
+from __future__ import annotations
+
+from .base import GraphPass
+
+__all__ = ["PallasFusionPass"]
+
+
+class PallasFusionPass(GraphPass):
+    name = "pallas_fusion"
+    flag = "MXTPU_PALLAS_FUSION"
+    mesh_safe = False          # GSPMD can't partition the custom call
+    modes = ("train", "infer", "serving")
+
+    def apply(self, sym, shapes, ctx):
+        from ..fusion import fuse_symbol
+        new_sym, rep = fuse_symbol(sym, shapes)
+        return (new_sym if rep["sites"] else None), rep

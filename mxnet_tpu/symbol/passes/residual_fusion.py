@@ -1,0 +1,173 @@
+"""Residual-chain fusion: BN(+ReLU)→conv of ANY geometry.
+
+The r6 Pallas pass only covers the 1×1/s1/p0 bottleneck convolutions —
+on a ResNet-50 residual block (bn→relu→conv1x1 → bn→relu→conv3x3 →
+bn→relu→conv1x1 + shortcut) that leaves the middle 3×3's BatchNorm, and
+every strided/shortcut conv's, as an unfused statistics barrier: naive
+autodiff materializes the normalized activation for the backward and
+walks separate mean-/var-chain passes over it. This pass extends the
+fusion to the REST of the chain: any ``BatchNorm → [ReLU →]
+Convolution`` site the Pallas pass did not claim (3×3, strided, padded,
+grouped-1, and tile-bailed 1×1s) rewrites onto ``_FusedBNReLUConvK``
+(ops/pallas_fused.py) — stock-XLA forward, but the same analytic fused
+BN backward with recompute-not-store residuals, which is where the
+bytes go. Together the two passes cover every BN in the bottleneck
+chain, which is what "residual-block-level" means here.
+
+Same structural match rules as the 1×1 pass (sole-consumer BN/ReLU,
+channel-axis BN, batch stats unconsumed, 4-D NCHW data) minus the tile
+constraints; runs AFTER pallas_fusion in the default pipeline so the
+Pallas kernel keeps the sites it tiles best.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from ..symbol import _Node
+from .base import GraphPass, parse_node_attrs, rebuild_graph
+
+__all__ = ["ResidualFusionPass"]
+
+_CONV_OPS = ("Convolution", "Convolution_v1")
+
+
+def _conv_general_matches(node, attrs) -> bool:
+    """Any-geometry ungrouped NCHW convolution with plain positional
+    inputs (data, weight[, bias])."""
+    if node.op not in _CONV_OPS:
+        return False
+    if "__input_names__" in node.attrs:
+        return False
+    if len(node.inputs) not in (2, 3):
+        return False
+    return (int(attrs.get("num_group", 1) or 1) == 1
+            and attrs.get("layout") in (None, "NCHW"))
+
+
+def match_bn_relu_conv(sym, shapes, conv_pred):
+    """Find ``BatchNorm → [ReLU →] Convolution`` sites where
+    ``conv_pred(node, attrs)`` accepts the conv. Returns
+    ``(sites: {id(conv): info}, report)`` — the same walk the 1×1 pass
+    uses (fusion.py), with the conv predicate factored out."""
+    _, node_shapes = sym._propagate_shapes(dict(shapes))
+    nodes = sym._topo_nodes()
+    heads = {(id(s._node), s._out_index) for s in sym._output_symbols()}
+    uses: Dict[tuple, int] = {}
+    for n in nodes:
+        for p, i in n.inputs:
+            uses[(id(p), i)] = uses.get((id(p), i), 0) + 1
+
+    def sole_feed(node, consumer):
+        k = (id(node), 0)
+        if k in heads or uses.get(k, 0) != 1:
+            return False
+        return sum(1 for p, i in consumer.inputs
+                   if p is node and i == 0) == 1
+
+    sites: Dict[int, dict] = {}
+    report = {"sites": [], "bailouts": []}
+    claimed = set()
+    for node in nodes:
+        cattrs = parse_node_attrs(node)
+        if not conv_pred(node, cattrs):
+            continue
+        src, src_idx = node.inputs[0]
+        if src_idx != 0 or id(src) in claimed:
+            continue
+        relu = None
+        if src.op == "Activation" and \
+                parse_node_attrs(src).get("act_type", "relu") == "relu":
+            relu = src
+            bn, bn_idx = relu.inputs[0]
+            if bn_idx != 0 or id(bn) in claimed:
+                continue
+        elif src.op in ("BatchNorm", "BatchNorm_v1"):
+            bn = src
+        else:
+            continue
+
+        def bail(reason):
+            report["bailouts"].append({"conv": node.name, "bn": bn.name,
+                                      "reason": reason})
+
+        battrs = parse_node_attrs(bn)
+        if bn.op not in ("BatchNorm", "BatchNorm_v1"):
+            continue
+        if "__input_names__" in bn.attrs or len(bn.inputs) != 5:
+            bail("BatchNorm with non-standard inputs")
+            continue
+        if int(battrs.get("axis", 1) or 1) != 1:
+            bail(f"BatchNorm axis={battrs.get('axis')} (need channel "
+                 "axis 1)")
+            continue
+        if relu is not None and not sole_feed(relu, node):
+            bail("activation output has other consumers")
+            continue
+        if not sole_feed(bn, relu if relu is not None else node):
+            bail("BatchNorm output has other consumers")
+            continue
+        if any(uses.get((id(bn), i), 0) or (id(bn), i) in heads
+               for i in (1, 2)):
+            bail("BatchNorm batch statistics are consumed in-graph")
+            continue
+        dshape = node_shapes.get((id(bn.inputs[0][0]), bn.inputs[0][1]))
+        if dshape is None or len(dshape) != 4:
+            bail(f"data shape unknown or not NCHW 4-D ({dshape})")
+            continue
+        claimed.update({id(bn)} | ({id(relu)} if relu is not None
+                                   else set()))
+        sites[id(node)] = {"bn": bn, "relu": relu, "battrs": battrs,
+                           "cattrs": cattrs, "dshape": dshape}
+        report["sites"].append({
+            "conv": node.name, "bn": bn.name,
+            "activation": relu.name if relu is not None else None,
+            "kernel": cattrs.get("kernel"),
+            "stride": cattrs.get("stride"),
+            "batch": int(dshape[0]), "k": int(dshape[1])})
+    return sites, report
+
+
+class ResidualFusionPass(GraphPass):
+    name = "residual_fusion"
+    flag = "MXTPU_PASS_RESIDUAL_FUSION"
+    mesh_safe = False          # composes with pallas_fusion's sites;
+    modes = ("train", "infer", "serving")  # mesh fusion is ROADMAP it.1
+
+    def apply(self, sym, shapes, ctx):
+        sites, report = match_bn_relu_conv(sym, shapes,
+                                           _conv_general_matches)
+        if not sites:
+            return None, report
+
+        def build_anchor(node, m, map_out, outmap):
+            bn, relu = m["bn"], m["relu"]
+            battrs, cattrs = m["battrs"], m["cattrs"]
+            inputs = [map_out(*bn.inputs[j]) for j in range(5)]
+            inputs.append(map_out(*node.inputs[1]))
+            no_bias = bool(cattrs.get("no_bias", False))
+            if len(node.inputs) > 2 and not no_bias:
+                inputs.append(map_out(*node.inputs[2]))
+            else:
+                no_bias = True
+            attrs = {
+                "eps": battrs.get("eps", 1e-3),
+                "momentum": battrs.get("momentum", 0.9),
+                "fix_gamma": battrs.get("fix_gamma", True),
+                "use_global_stats": battrs.get("use_global_stats", False),
+                "act_type": "relu" if relu is not None else None,
+                "kernel": cattrs.get("kernel"),
+                "stride": cattrs.get("stride"),
+                "pad": cattrs.get("pad"),
+                "dilate": cattrs.get("dilate"),
+                "num_filter": cattrs.get("num_filter"),
+                "num_group": 1,
+                "no_bias": no_bias,
+            }
+            fused = _Node("_FusedBNReLUConvK", node.name, attrs=attrs,
+                          inputs=inputs, num_outputs=3,
+                          user_attrs=node.user_attrs)
+            fused.uid = node.uid
+            outmap[(id(node), 0)] = (fused, 0)
+            return fused
+
+        return rebuild_graph(sym, sites, build_anchor), report

@@ -317,7 +317,7 @@ class Symbol:
                  if not k.startswith("__")}
         opdef = get_op(node.op)
         if node.op in ("BatchNorm", "BatchNorm_v1", "Dropout", "RNN",
-                       "_FusedBNReLUConv"):
+                       "_FusedBNReLUConv", "_FusedBNReLUConvK"):
             attrs["training"] = training
         if node.op in ("Dropout", "RNN") and training:
             base = rng_key if rng_key is not None \
@@ -344,7 +344,8 @@ class Symbol:
         at input positions 3/4, batch stats at outputs 1/2 — exactly so
         this fold applies to it unchanged."""
         if not training or node.op not in (
-                "BatchNorm", "BatchNorm_v1", "_FusedBNReLUConv") \
+                "BatchNorm", "BatchNorm_v1", "_FusedBNReLUConv",
+                "_FusedBNReLUConvK") \
                 or attrs.get("use_global_stats"):
             return []
         momentum = attrs.get("momentum", 0.9)
@@ -360,8 +361,14 @@ class Symbol:
 
     def eval_arrays_ex(self, arg_arrays: Dict[str, "np.ndarray"],
                       training=False, rng_key=None, internals=None,
-                      device_map=None):
+                      device_map=None, preset=None):
         """Evaluate; returns (outputs, aux_updates).
+
+        ``preset``: optional ``{(id(node), out_idx): value}`` seed for
+        the evaluation cache — the parameter-expression hoisting hook
+        (symbol/passes/hoist.py): a preset output short-circuits its
+        whole subgraph, so variables only reachable through it need not
+        appear in ``arg_arrays``.
 
         ``internals``: optional dict filled with every op node's outputs
         keyed ``{node.name}_output`` — the Monitor tap point (reference:
@@ -381,7 +388,7 @@ class Symbol:
         OUTSIDE jit (the group2ctx Executor path runs unjitted)."""
         import jax
         import jax.numpy as jnp
-        cache: Dict[tuple, object] = {}
+        cache: Dict[tuple, object] = dict(preset) if preset else {}
         aux_updates: Dict[str, object] = {}
 
         def node_out(node, idx):
@@ -493,7 +500,7 @@ class Symbol:
             names = set()
             for n in nodes:
                 if n.op not in ("BatchNorm", "BatchNorm_v1",
-                                "_FusedBNReLUConv"):
+                                "_FusedBNReLUConv", "_FusedBNReLUConvK"):
                     continue
                 attrs = {k: parse_attr(v) for k, v in n.attrs.items()
                          if not k.startswith("__")}

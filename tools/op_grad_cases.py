@@ -71,6 +71,19 @@ CASES = {
         attrs=dict(fix_gamma=False, num_filter=16, no_bias=True,
                    training=True, act_type=None),
         grad_args=[0, 1, 2, 5], tol=(5e-2, 5e-3)),
+    "_FusedBNReLUConvK": dict(
+        # general-geometry BN(+conv) fused op (round 12,
+        # ops/pallas_fused.py): a 3x3/stride-2 site the Pallas op can't
+        # take, through the same analytic custom VJP. Bare-BN variant
+        # for the same FD-smoothness reason as _FusedBNReLUConv; the
+        # relu path is pinned against autodiff in tests/test_passes.py.
+        inputs=[_img((2, 8, 5, 5)), _pos((8,), 1), _signed((8,), 2),
+                _signed((8,), 3), _pos((8,), 4),
+                _signed((6, 8, 3, 3), 5)],
+        attrs=dict(fix_gamma=False, num_filter=6, no_bias=True,
+                   training=True, act_type=None, kernel=(3, 3),
+                   stride=(2, 2), pad=(1, 1)),
+        grad_args=[0, 1, 2, 5], tol=(5e-2, 5e-3)),
     "LayerNorm": dict(
         inputs=[_signed((3, 6), 0), _pos((6,), 1), _signed((6,), 2)]),
     "InstanceNorm": dict(

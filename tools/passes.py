@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Dump graph-rewrite pass decisions for a symbol JSON; CI bytes gate.
+
+The pass framework (mxnet_tpu/symbol/passes/) decides per program which
+rewrites fire, skip, or get rejected by the measured bytes-accessed
+gate. This CLI replays the pipeline on a saved symbol so those
+decisions are inspectable OUTSIDE a training/serving process — and
+gateable in CI:
+
+    passes.py dump SYMBOL.json --shape data=8,3,224,224
+              [--shape softmax_label=8] [--mode train|infer|serving]
+              [--data-names data,softmax_label]
+              [--force pass=1 ...] [--json]
+              [--assert-bytes]
+
+``dump`` prints one line per pass — fired (site count + measured bytes
+delta) / skipped (reason) / rejected (reason) / no_match — plus the
+baseline and final bytes-accessed of the program proxy. With
+``--assert-bytes`` it exits 2 unless the final program moves STRICTLY
+fewer bytes than the unrewritten one: the CI gate companion to
+``tools/telemetry.py diff --gate-bytes`` (that one compares two runs'
+snapshots; this one pins a symbol's pipeline in isolation).
+
+``--force pallas_fusion=1`` (repeatable) forces a pass's env flag for
+the invocation; the measured gate still applies per
+MXTPU_PASS_GATE_BYTES (default auto: forced passes are trusted — pass
+``--gate 1`` to measure and gate everything, which --assert-bytes
+implies for its final verdict anyway).
+
+Flags left at ``auto`` count as ON for the replay: ``auto`` resolves
+to off-TPU-off in-process, which would make every CPU replay (the
+normal CI posture, JAX_PLATFORMS=cpu) a silent no-op — and a no-op
+pipeline trivially fails --assert-bytes. Pass ``--respect-auto`` to
+keep the in-process resolution instead. Byte counts are XLA cost
+analysis of the program lowered on whatever backend JAX selects, the
+same objective the in-process gate uses.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+_FLAGS = {
+    "pallas_fusion": "MXTPU_PALLAS_FUSION",
+    "residual_fusion": "MXTPU_PASS_RESIDUAL_FUSION",
+    "bn_fold": "MXTPU_PASS_BN_FOLD",
+    "bf16_cast": "MXTPU_PASS_BF16",
+}
+
+
+def _parse_shape(spec):
+    name, _, dims = spec.partition("=")
+    if not dims:
+        sys.exit(f"bad --shape {spec!r}: want name=d0,d1,...")
+    try:
+        return name, tuple(int(d) for d in dims.split(","))
+    except ValueError:
+        sys.exit(f"bad --shape {spec!r}: non-integer dim")
+
+
+def cmd_dump(args):
+    for spec in args.force or ():
+        name, _, val = spec.partition("=")
+        env = _FLAGS.get(name)
+        if env is None:
+            sys.exit(f"--force {spec!r}: unknown pass {name!r} "
+                     f"(know {sorted(_FLAGS)})")
+        os.environ[env] = val or "1"
+    if args.gate:
+        os.environ["MXTPU_PASS_GATE_BYTES"] = args.gate
+    if not args.respect_auto:
+        # replay posture: un-forced `auto` flags count as ON (see the
+        # module docstring) so an off-TPU replay actually replays
+        for env in _FLAGS.values():
+            if os.environ.get(env) in (None, "", "auto"):
+                os.environ[env] = "1"
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.symbol import passes as P
+
+    sym = mx.sym.load(args.symbol)
+    given = dict(_parse_shape(s) for s in args.shape)
+    try:
+        arg_shapes, _, aux_shapes = sym.infer_shape(**given)
+    except Exception as e:
+        sys.exit(f"shape inference failed ({e}); pass --shape for every "
+                 "data input")
+    shapes = dict(zip(sym.list_arguments(), arg_shapes))
+    shapes.update(zip(sym.list_auxiliary_states(), aux_shapes))
+
+    data_names = None
+    if args.data_names:
+        data_names = set(args.data_names.split(","))
+    elif args.mode == "serving":
+        data_names = set(given)
+
+    final, report = P.apply_pipeline(
+        sym, shapes, tag=f"cli:{os.path.basename(args.symbol)}",
+        mode=args.mode, data_names=data_names)
+
+    baseline = P.measure_symbol_bytes(sym, shapes, mode=args.mode,
+                                      data_names=data_names)
+    final_bytes = P.measure_symbol_bytes(
+        final, shapes, mode=args.mode, data_names=data_names) \
+        if final is not None else baseline
+
+    out = {
+        "symbol": args.symbol,
+        "mode": args.mode,
+        "baseline_bytes": baseline,
+        "final_bytes": final_bytes,
+        "saving_pct": round((1.0 - final_bytes / baseline) * 100.0, 3)
+        if baseline and final_bytes else None,
+        "passes": [{k: v for k, v in e.items()} for e in
+                   report["passes"]],
+    }
+    if args.json:
+        print(json.dumps(out, indent=1, default=str))
+    else:
+        for e in report["passes"]:
+            line = f"{e['pass']:<18} {e['status']:<12}"
+            if e["status"] == "applied":
+                line += f" sites={len(e['sites'])}"
+                if e.get("bytes_delta") is not None:
+                    line += f" bytes_delta={e['bytes_delta']:+.0f}"
+            elif e.get("reason"):
+                line += f" ({e['reason']})"
+            if e["status"] == "no_match" and e["bailouts"]:
+                line += f" bailouts={len(e['bailouts'])}"
+            print(line)
+        if baseline and final_bytes:
+            print(f"bytes: {baseline:.6g} -> {final_bytes:.6g} "
+                  f"({out['saving_pct']:+.3f}% saved)")
+    if args.assert_bytes:
+        if baseline is None or final_bytes is None:
+            print("ASSERT-BYTES: cost analysis unavailable on this "
+                  "backend — cannot gate", file=sys.stderr)
+            return 2
+        if final_bytes >= baseline:
+            print(f"ASSERT-BYTES FAILED: pipeline program moves "
+                  f"{final_bytes:.6g} bytes, not strictly below the "
+                  f"unrewritten {baseline:.6g} — in the bandwidth-bound "
+                  "regime that is a throughput regression (ROADMAP "
+                  "item 2's currency)", file=sys.stderr)
+            return 2
+        print("bytes gate OK", file=sys.stderr)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Dump pass-pipeline decisions for a symbol JSON; "
+                    "--assert-bytes is the CI gate")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("dump", help="run the pipeline and print every "
+                                    "pass decision")
+    p.add_argument("symbol", help="path to a Symbol JSON "
+                                  "(Symbol.save output)")
+    p.add_argument("--shape", action="append", default=[],
+                   required=True, metavar="NAME=D0,D1,...",
+                   help="data input shape (repeatable); remaining "
+                        "arg/aux shapes are inferred")
+    p.add_argument("--mode", default="train",
+                   choices=("train", "infer", "serving"))
+    p.add_argument("--data-names", default=None,
+                   help="comma list of per-call inputs (serving "
+                        "hoisting boundary; default: the --shape names "
+                        "in serving mode)")
+    p.add_argument("--force", action="append", default=[],
+                   metavar="PASS=FLAG",
+                   help="force a pass flag, e.g. pallas_fusion=1")
+    p.add_argument("--gate", default=None, choices=("auto", "1", "0"),
+                   help="override MXTPU_PASS_GATE_BYTES")
+    p.add_argument("--respect-auto", action="store_true",
+                   help="resolve un-forced flags exactly as the "
+                        "process would (auto = off-TPU off) instead of "
+                        "counting them as on for the replay")
+    p.add_argument("--assert-bytes", action="store_true",
+                   help="exit 2 unless the final program moves strictly "
+                        "fewer bytes than the unrewritten one")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_dump)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
