@@ -73,6 +73,8 @@ from . import compile  # noqa: A004 — package named for mxnet_tpu.compile
 from .compile import compile_report
 from . import checkpoint
 from .checkpoint import CheckpointManager
+from . import sparse
+from .sparse import sparse_report
 from . import contrib
 from . import gluon
 from . import rnn

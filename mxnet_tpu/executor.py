@@ -138,14 +138,18 @@ def build_graph_fns(sym, device_map=None):
 
     loss_specs = collect_loss_specs(sym)
 
-    def fwd_loss(arg_vals, aux_vals, head_grads, key):
+    def fwd_loss(arg_vals, aux_vals, head_grads, key, preset=None):
         amap = dict(zip(arg_names, arg_vals))
         amap.update(zip(aux_names, aux_vals))
         outs, aux_updates = sym.eval_arrays_ex(amap, training=True,
                                                rng_key=key,
-                                               device_map=device_map)
+                                               device_map=device_map,
+                                               preset=preset)
         # recompute each head's loss from the head node's *inputs* (XLA
-        # CSE dedups against the forward eval)
+        # CSE dedups against the forward eval). ``preset`` — values
+        # seeded for specific nodes (the fused step's row-sparse
+        # embedding routing) — must reach the recompute too, or the
+        # seeded branch would fork from the loss actually trained on.
         head_inputs = []
         for i, node, attrs in loss_specs:
             ins = []
@@ -153,7 +157,8 @@ def build_graph_fns(sym, device_map=None):
                 sub = type(sym)(p, oi)
                 ins.append(sub.eval_arrays(amap, training=True,
                                            rng_key=key,
-                                           device_map=device_map)[0])
+                                           device_map=device_map,
+                                           preset=preset)[0])
             head_inputs.append(ins)
         total = total_implicit_loss(loss_specs, head_inputs, outs,
                                     head_grads)

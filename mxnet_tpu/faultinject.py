@@ -3,7 +3,7 @@
 Faults are armed **by site and ordinal**, never randomly: a spec names a
 site (``ckpt_write``, ``nan_grad``, ``data_iter``, ``data_worker``,
 ``dist_drop``, ``dist_init``, ``ckpt_truncate``, ``compile_cache``,
-``telemetry_write``) plus
+``telemetry_write``, ``sparse_update``) plus
 the exact coordinate at which it fires (byte offset, step index, batch
 index, call ordinal). ``telemetry_write`` is consulted by the durable
 telemetry exporter (telemetry/export.py) on every event append
@@ -16,7 +16,11 @@ rename commits. ``data_iter`` fires on the consumer thread at an iterator's
 B-th ``next()``; ``data_worker`` fires INSIDE a data-pipeline decode
 worker at the B-th produced batch (``data/pipeline.py``) — with
 ``action=kill`` it is the dying-input-worker drill the chaos suite
-resumes from checkpoint. The same spec
+resumes from checkpoint. ``sparse_update`` fires in the fused step at
+the boundary where a row-sparse embedding update would commit
+(``step=N``); with ``action=kill`` it is the kill-mid-row-scatter drill
+proving checkpoint/resume restores sharded tables and lazy optimizer
+state bit-for-bit. The same spec
 always produces the same failure, so CI chaos suites are reproducible
 bit-for-bit (contrast: the classic chaos-monkey coin flip, useless as a
 regression gate).

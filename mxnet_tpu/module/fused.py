@@ -126,6 +126,9 @@ class FusedSymbolStep:
         self._small_names = None
         self._aux_big_names = None
         self._aux_small_names = None
+        # row-sparse embedding routing (sparse/): sites detected at
+        # start() on the traced graph; [] = every gradient dense
+        self._sparse_sites = []
 
     @property
     def started(self):
@@ -208,6 +211,26 @@ class FusedSymbolStep:
             _, self._fwd_loss, _ = build_graph_fns(fused_sym)
             self._run_arg_names = fused_sym.list_arguments()
             self._run_aux_names = fused_sym.list_auxiliary_states()
+        # row-sparse embedding routing: SparseEmbedding nodes whose ids
+        # are a direct feed and whose table is a trainable parameter get
+        # rows-only gradients (perturbation trick in _build) + the lazy
+        # row optimizer rule — the dense (vocab, dim) cotangent is never
+        # materialized. Detection runs on the TRACED graph (node ids key
+        # the eval preset). No lazy rule for this optimizer -> every
+        # site falls back to the dense custom-VJP path, counted.
+        run_sym = fused_sym if fused_sym is not None else self.symbol
+        self._sparse_sites = []
+        from ..sparse.embedding import find_sites as _find_sites
+        from ..telemetry import registry as _treg
+        all_sites = _find_sites(run_sym, self.param_names,
+                                self.input_names, shapes)
+        if all_sites and self._fopt.row_update is None:
+            _treg.counter("sparse::dense_fallback").inc(len(all_sites))
+        elif all_sites:
+            self._sparse_sites = [
+                s for s in all_sites
+                if self.trainable.get(s.weight_name, True)]
+            _treg.gauge("sparse::sites").set(len(self._sparse_sites))
         rep = self._rep_sharding()
 
         def _prep(v):
@@ -271,6 +294,19 @@ class FusedSymbolStep:
         has_flat_aux = self._aux_total > 0
         flat_lrm = self._flat_lrm if has_flat else None
         flat_wd = self._flat_wd if has_flat else None
+        # row-sparse embedding routing: tables backing a detected site
+        # leave the differentiated param set — their gradient is taken
+        # wrt a zero PERTURBATION of the gathered rows instead, then
+        # deduplicated to unique rows (sparse/rowsparse.py). The dense
+        # (vocab, dim) cotangent never exists in the program.
+        from ..sparse.rowsparse import RowSparseRows, dedup_rows
+        sites = [s for s in self._sparse_sites
+                 if s.weight_name in big_pos]
+        site_big_idx = [big_pos[s.weight_name] for s in sites]
+        sparse_set = set(site_big_idx)
+        dense_idx = [i for i in range(len(self._big_names))
+                     if i not in sparse_set]
+        dense_pos = {i: j for j, i in enumerate(dense_idx)}
 
         cdt = self.compute_dtype
 
@@ -291,10 +327,22 @@ class FusedSymbolStep:
                     base_key):
             key = jax.random.fold_in(base_key, t)
 
-            def floss(pv, fp):
+            # zero perturbations of each site's gathered rows: the
+            # gradient wrt them IS the gradient wrt the gathered
+            # activations, which dedup_rows turns into rows-only form
+            perts = tuple(
+                jnp.zeros(feed_vals[input_pos[s.ids_name]].shape
+                          + (s.dim,), jnp.float32) for s in sites)
+
+            def floss(pv_dense, fp, pert):
                 def val(n):
                     if n in big_pos:
-                        return _cast(pv[big_pos[n]])
+                        i = big_pos[n]
+                        if i in dense_pos:
+                            return _cast(pv_dense[dense_pos[i]])
+                        # sparse table: reaches the loss only through
+                        # the preset gather below — no dense cotangent
+                        return _cast(pvals[i])
                     if n in small_off:
                         o, sz, shp = small_off[n]
                         return _cast(jax.lax.slice(fp, (o,), (o + sz,))
@@ -311,17 +359,48 @@ class FusedSymbolStep:
                                  .reshape(shp))
 
                 aux_in = tuple(aux_val(n) for n in aux_names)
+                preset = None
+                if sites:
+                    preset = {}
+                    for k, s in enumerate(sites):
+                        w = pvals[site_big_idx[k]].astype(jnp.float32)
+                        ids = feed_vals[input_pos[s.ids_name]] \
+                            .astype(jnp.int32)
+                        preset[(id(s.node), 0)] = _cast(
+                            jnp.take(w, ids, axis=0) + pert[k])
                 total, (outs, aux_up) = fwd_loss(arg_vals, aux_in, None,
-                                                 key)
+                                                 key, preset=preset)
                 return total, (outs, aux_up)
 
+            pv_dense = tuple(pvals[i] for i in dense_idx)
+            argnums = (0, 1, 2) if has_flat else (0, 2)
+            grads, (outs, aux_up) = jax.grad(
+                floss, argnums=argnums, has_aux=True)(
+                    pv_dense, flat_p, perts)
             if has_flat:
-                grads, (outs, aux_up) = jax.grad(
-                    floss, argnums=(0, 1), has_aux=True)(pvals, flat_p)
-                grads_big, grad_flat = grads
+                gd, grad_flat, gperts = grads
             else:
-                grads_big, (outs, aux_up) = jax.grad(
-                    floss, has_aux=True)(pvals, flat_p)
+                gd, gperts = grads
+                grad_flat = None
+            grads_big = [None] * len(pvals)
+            for j, i in enumerate(dense_idx):
+                grads_big[i] = gd[j]
+            if sites:
+                # merge sites sharing one table, then ONE dedup per
+                # table: unique sorted ids + segment-summed rows
+                merged = {}
+                for k, s in enumerate(sites):
+                    ids = feed_vals[input_pos[s.ids_name]] \
+                        .astype(jnp.int32).reshape(-1)
+                    dg = gperts[k].reshape(ids.shape[0], s.dim) \
+                        .astype(jnp.float32)
+                    merged.setdefault(site_big_idx[k], []) \
+                        .append((ids, dg, s.vocab))
+                for i, parts in merged.items():
+                    ids = jnp.concatenate([x[0] for x in parts])
+                    dg = jnp.concatenate([x[1] for x in parts])
+                    grads_big[i] = dedup_rows(ids, dg,
+                                              num_rows=parts[0][2])
             def _apply():
                 """The real update: optimizer step + BN aux fold +
                 in-step metric advance."""
@@ -329,11 +408,19 @@ class FusedSymbolStep:
                 for i, (p, g, s, tr) in enumerate(
                         zip(pvals, grads_big, opt_state, trainable)):
                     if tr:
-                        pkey = jax.random.fold_in(
-                            jax.random.fold_in(key, 0x6F707469), i) \
-                            if fopt.needs_key else None
-                        np_, ns_ = fopt.update(p, g, s, lr * lr_mults[i],
-                                               t + 1, wd_eff[i], key=pkey)
+                        if isinstance(g, RowSparseRows):
+                            # lazy rows-only update: momentum/moments
+                            # and weight decay advance on touch only
+                            np_, ns_ = fopt.row_update(
+                                p, g.ids, g.rows, s, lr * lr_mults[i],
+                                t + 1, wd_eff[i])
+                        else:
+                            pkey = jax.random.fold_in(
+                                jax.random.fold_in(key, 0x6F707469), i) \
+                                if fopt.needs_key else None
+                            np_, ns_ = fopt.update(
+                                p, g, s, lr * lr_mults[i],
+                                t + 1, wd_eff[i], key=pkey)
                         new_p.append(np_.astype(p.dtype))
                         new_s.append(ns_)
                     else:
@@ -391,6 +478,8 @@ class FusedSymbolStep:
                 gnorm = jnp.float32(0)
                 for g in list(grads_big) + \
                         ([grad_flat] if has_flat else []):
+                    if isinstance(g, RowSparseRows):
+                        g = g.rows      # sentinel rows are exact zeros
                     gnorm = gnorm + jnp.sum(jnp.abs(g),
                                             dtype=jnp.float32)
                 finite = jnp.isfinite(gnorm)
@@ -558,6 +647,17 @@ class FusedSymbolStep:
         if self._step_jit is None:
             self._build()
         from .. import faultinject
+        if self._sparse_sites:
+            # the kill-mid-row-scatter drill: with action=kill the
+            # process dies at the step boundary where the row update
+            # would commit — the chaos suite proves resume restores
+            # table + lazy optimizer state bit-for-bit from the last
+            # checkpoint (a mid-program death can't tear donated
+            # buffers; the step is atomic from the host's view)
+            faultinject.fire("sparse_update", step=self.num_update)
+            from .. import sparse as _sparse
+            if _sparse.stats_enabled():
+                _sparse.note_step_ids(self._sparse_sites, feed)
         if faultinject.fire("nan_grad", step=self.num_update):
             # poison the float data inputs: the SAME compiled program
             # produces NaN gradients, exercising the in-graph guard with
@@ -645,6 +745,10 @@ class FusedSymbolStep:
                                 for n, v in self.trainable.items()),
             "metrics": repr(tuple(self._metric_sigs)),
             "compiler_options": self._jit_options,
+            # sparse routing config: which sites carry row-sparse
+            # gradients (and their vocab/dim) changes the traced
+            # program — a dense-vs-sparse flip must never cache-hit
+            "sparse": [s.describe() for s in self._sparse_sites],
         }
         return compile_mod.program_key(
             "fused_step", f"fused_step:{self.symbol.name}",

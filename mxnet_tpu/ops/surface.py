@@ -477,14 +477,34 @@ def bipartite_matching(data, is_ascend=False, threshold=0.5, topk=-1, **kw):
     return row_m, col_m
 
 
-def _embedding_fwd(data, weight, input_dim=None, output_dim=None,
-                   dtype="float32", sparse_grad=False, **kw):
-    return get_op("Embedding").fn(data, weight, input_dim=input_dim,
-                                  output_dim=output_dim, dtype=dtype, **kw)
+def _sparse_embedding_fwd(data, weight, input_dim=None, output_dim=None,
+                          dtype="float32", sparse_grad=True, **kw):
+    """Reference: src/operator/tensor/indexing_op.cc SparseEmbedding.
+    Forward is the same gather as dense Embedding; the custom VJP
+    (sparse/embedding.py) dedups the backward to unique rows via
+    segment-sum — one (n, dim) scatter instead of one per occurrence.
+    The fused Module step detects these nodes and never materializes
+    the dense (vocab, dim) cotangent at all (row-sparse routing)."""
+    from ..sparse.embedding import sparse_embedding
+    return sparse_embedding(data, weight)
 
 
 register_op("_contrib_SparseEmbedding",
-            aliases=["SparseEmbedding"])(_embedding_fwd)
+            aliases=["SparseEmbedding"])(_sparse_embedding_fwd)
+
+
+def _sparse_segment_sum(data, segment_ids, num_segments=None, **kw):
+    """Row dedup building block (sparse/rowsparse.py): sums data rows
+    into num_segments buckets. Registered so the numerical-gradient
+    sweep (tools/op_grad_cases.py) covers the segment-sum the
+    SparseEmbedding backward is built from."""
+    from ..sparse.rowsparse import segment_rows
+    n = int(num_segments) if num_segments is not None \
+        else int(data.shape[0])
+    return segment_rows(data, segment_ids, n)
+
+
+register_op("_contrib_sparse_segment_sum")(_sparse_segment_sum)
 
 
 # ---------------------------------------------------------------------------

@@ -27,7 +27,8 @@ from typing import Callable, Dict
 import jax
 import jax.numpy as jnp
 
-__all__ = ["create", "from_optimizer", "supported", "FunctionalOptimizer"]
+__all__ = ["create", "from_optimizer", "supported", "row_supported",
+           "FunctionalOptimizer"]
 
 
 class FunctionalOptimizer:
@@ -41,15 +42,27 @@ class FunctionalOptimizer:
     State leaves from ``init`` may be parameter-shaped or scalar
     (pack-shared, e.g. nadam's m_schedule) — any other shape would break
     the packed state IO.
+
+    ``row_update`` (sgd/adam) is the lazy row-sparse rule matching the
+    reference's ``lazy_update=True`` semantics (optimizer.py SGD/Adam
+    with row_sparse grads): ``row_update(p, uids, rows, s, lr, t, wd)``
+    updates ONLY the rows named by ``uids`` — momentum decay, moment
+    EMAs and weight decay all advance on touch, untouched rows are
+    bit-frozen. Ids read with clip and written with drop, so padded
+    sentinel ids (and out-of-shard ids under shard_map rebasing —
+    sparse/sharding.py) are structural no-ops. None on rules without a
+    lazy form.
     """
 
     def __init__(self, name, init_fn, update_fn, needs_key=False,
-                 elementwise=True):
+                 elementwise=True, row_update_fn=None):
         self.name = name
         self.init = init_fn            # p -> state tuple
         self._update = update_fn       # (p, g, s, lr, t, wd, key) -> (p, s)
         self.needs_key = needs_key
         self.elementwise = elementwise
+        # (p, uids, rows, s, lr, t, wd) -> (p, s); None = no lazy form
+        self.row_update = row_update_fn
 
     def update(self, p, g, s, lr, t, wd=0.0, key=None):
         return self._update(p, g, s, lr, t, wd, key)
@@ -68,6 +81,12 @@ def _factory(*names):
 
 def supported():
     return sorted(_FACTORIES)
+
+
+def row_supported():
+    """Optimizer names with a lazy row-sparse rule."""
+    return sorted(n for n in _FACTORIES
+                  if create(n).row_update is not None)
 
 
 # hyperparameter names each rule accepts (plus the common prologue keys);
@@ -142,6 +161,7 @@ def _zeros(p):
 @_factory("sgd")
 def _make_sgd(kw):
     momentum = kw.get("momentum", 0.0)
+    lazy = kw.get("lazy_update", True)
 
     def init(p):
         return (_zeros(p),) if momentum else ()
@@ -154,7 +174,23 @@ def _make_sgd(kw):
             return p + mom, (mom,)
         return p - lr * g, ()
 
-    return FunctionalOptimizer("sgd", init, update)
+    def row_update(p, uids, rows, s, lr, t, wd):
+        # lazy SGD (reference: optimizer.py SGD lazy_update): only the
+        # touched rows advance — weight decay applies on touch, the
+        # momentum of untouched rows stays frozen
+        pr = jnp.take(p, uids, axis=0, mode="clip").astype(jnp.float32)
+        g = _g32(rows, pr, kw) + wd * pr
+        if momentum:
+            (mom,) = s
+            mr = jnp.take(mom, uids, axis=0, mode="clip")
+            mr = momentum * mr - lr * g
+            p = p.at[uids].add(mr.astype(p.dtype), mode="drop")
+            mom = mom.at[uids].set(mr, mode="drop")
+            return p, (mom,)
+        return p.at[uids].add((-lr * g).astype(p.dtype), mode="drop"), ()
+
+    return FunctionalOptimizer("sgd", init, update,
+                               row_update_fn=row_update if lazy else None)
 
 
 @_factory("nag")
@@ -239,6 +275,7 @@ def _make_adam(kw):
     beta1 = kw.get("beta1", 0.9)
     beta2 = kw.get("beta2", 0.999)
     epsilon = kw.get("epsilon", 1e-8)
+    lazy = kw.get("lazy_update", True)
 
     def init(p):
         return (_zeros(p), _zeros(p))
@@ -252,7 +289,28 @@ def _make_adam(kw):
         lr_t = lr * jnp.sqrt(1 - beta2 ** tf) / (1 - beta1 ** tf)
         return p - lr_t * mean / (jnp.sqrt(var) + epsilon), (mean, var)
 
-    return FunctionalOptimizer("adam", init, update)
+    def row_update(p, uids, rows, s, lr, t, wd):
+        # lazy Adam (reference: optimizer.py Adam lazy_update): moment
+        # EMAs advance only for touched rows; bias correction uses the
+        # GLOBAL step count (the reference's documented approximation —
+        # exact vs dense when every row is touched every step)
+        pr = jnp.take(p, uids, axis=0, mode="clip").astype(jnp.float32)
+        g = _g32(rows, pr, kw) + wd * pr
+        mean, var = s
+        mr = jnp.take(mean, uids, axis=0, mode="clip")
+        vr = jnp.take(var, uids, axis=0, mode="clip")
+        mr = beta1 * mr + (1 - beta1) * g
+        vr = beta2 * vr + (1 - beta2) * jnp.square(g)
+        tf = t.astype(jnp.float32)
+        lr_t = lr * jnp.sqrt(1 - beta2 ** tf) / (1 - beta1 ** tf)
+        delta = -lr_t * mr / (jnp.sqrt(vr) + epsilon)
+        p = p.at[uids].add(delta.astype(p.dtype), mode="drop")
+        mean = mean.at[uids].set(mr, mode="drop")
+        var = var.at[uids].set(vr, mode="drop")
+        return p, (mean, var)
+
+    return FunctionalOptimizer("adam", init, update,
+                               row_update_fn=row_update if lazy else None)
 
 
 @_factory("adamax")
@@ -509,11 +567,11 @@ def _make_test(kw):
 # attrs each eager class carries, keyed by its registered (lowercase) name;
 # every entry also pulls rescale_grad/clip_gradient from the base class
 _ATTR_MAP = {
-    "sgd": ("momentum",),
+    "sgd": ("momentum", "lazy_update"),
     "nag": ("momentum",),
     "lbsgd": ("momentum", "warmup_strategy", "warmup_epochs",
               "updates_per_epoch", "batch_scale"),
-    "adam": ("beta1", "beta2", "epsilon"),
+    "adam": ("beta1", "beta2", "epsilon", "lazy_update"),
     "adamax": ("beta1", "beta2"),
     "nadam": ("beta1", "beta2", "epsilon", "schedule_decay"),
     "ftml": ("beta1", "beta2", "epsilon"),

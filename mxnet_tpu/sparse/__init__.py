@@ -1,0 +1,93 @@
+"""Sparse embedding subsystem (round 13).
+
+The JAX-native rebuild of the reference's ``row_sparse`` storage for
+embedding-dominated models (PAPER.md L3/L6): a traced rows-only gradient
+carrier (:mod:`.rowsparse`), the ``SparseEmbedding`` op with a deduped
+backward plus fused-step site detection (:mod:`.embedding`), and
+mesh-row-sharded tables with shard-proportional optimizer state
+(:mod:`.sharding`). The fused Module step (module/fused.py) routes
+detected sites through these primitives; the lazy per-row optimizer
+rules live in parallel/functional_opt.py.
+
+Observability: ``sparse::`` metrics in the unified telemetry registry —
+``touched_rows`` / ``ids_total`` (counters), ``dedup_ratio`` /
+``gather_bytes`` / ``scatter_bytes`` (gauges, last step), and the
+``sparse_report()`` view. Host-side id stats cost one ``np.unique`` per
+step and sync the ids feed, so they are gated by ``MXTPU_SPARSE_STATS``
+(``auto`` = on everywhere except a real TPU backend, where the sync
+would serialize the dispatch pipeline).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .rowsparse import (RowSparseRows, dedup_rows, segment_rows,
+                        scatter_rows, densify)
+from .embedding import sparse_embedding, SparseSite, find_sites
+from .sharding import ShardedEmbeddingTable, shard_spec
+
+__all__ = ["RowSparseRows", "dedup_rows", "segment_rows", "scatter_rows",
+           "densify", "sparse_embedding", "SparseSite", "find_sites",
+           "ShardedEmbeddingTable", "shard_spec", "stats_enabled",
+           "note_step_ids", "sparse_report"]
+
+
+def stats_enabled():
+    """MXTPU_SPARSE_STATS: ``1`` force on, ``0`` force off, ``auto`` =
+    on unless the default backend is a TPU (host id-stats sync the feed;
+    on the CPU/GPU proxies that is free, on a TPU it stalls dispatch)."""
+    from .. import config as _config
+    v = str(_config.get("MXTPU_SPARSE_STATS", "auto")).strip().lower()
+    if v in ("1", "true", "yes", "on"):
+        return True
+    if v in ("0", "false", "no", "off"):
+        return False
+    try:
+        import jax
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+def note_step_ids(sites, feed):
+    """Record per-step sparse telemetry from the host-side ids feed:
+    total ids, unique rows touched, dedup ratio, and the gather/scatter
+    byte economics (dense-gradient bytes avoided = ``vocab*dim*4`` minus
+    the rows actually moved)."""
+    from ..telemetry import registry as _treg
+    ids_total = 0
+    touched = 0
+    gather_b = 0
+    scatter_b = 0
+    for site in sites:
+        ids = feed.get(site.ids_name)
+        if ids is None:
+            continue
+        arr = np.asarray(ids).reshape(-1)
+        ids_total += arr.size
+        u = int(np.unique(arr).size)
+        touched += u
+        gather_b += arr.size * site.dim * 4
+        scatter_b += u * site.dim * 4
+    if ids_total == 0:
+        return
+    _treg.counter("sparse::steps").inc()
+    _treg.counter("sparse::ids_total").inc(ids_total)
+    _treg.counter("sparse::touched_rows").inc(touched)
+    _treg.gauge("sparse::dedup_ratio").set(touched / float(ids_total))
+    _treg.gauge("sparse::gather_bytes").set(gather_b)
+    _treg.gauge("sparse::scatter_bytes").set(scatter_b)
+
+
+def _collect(reset):
+    from ..telemetry import registry as _treg
+    snap = _treg.snapshot(reset=reset, prefix="sparse::")
+    out = {}
+    for name, vals in snap.items():
+        out[name.split("::", 1)[1]] = vals.get("value")
+    return out
+
+
+from ..telemetry import registry as _treg_mod  # noqa: E402
+
+sparse_report = _treg_mod.collector_view("sparse", _collect)

@@ -1,0 +1,112 @@
+"""SparseEmbedding: the lookup op with a rows-only backward.
+
+Two layers share the math in rowsparse.py:
+
+- :func:`sparse_embedding` — the op-level primitive behind the
+  ``_contrib_SparseEmbedding`` registry entry (ops/surface.py). Its
+  custom VJP computes the weight cotangent by deduplicating to unique
+  rows (segment-sum) and issuing ONE scatter of ``(n, dim)`` rows,
+  instead of jax's default one-hot-matmul/scatter over every occurrence.
+  The VJP contract forces the returned cotangent to be dense
+  ``(vocab, dim)`` — standalone ``jax.grad`` users and the numerical
+  sweep in tools/op_grad_cases.py see a normal gradient.
+- :func:`find_sites` — the graph scan the fused Module step uses to
+  route embedding gradients AROUND the dense cotangent entirely: for
+  each site it perturbs the gathered activations, differentiates wrt
+  the perturbation, and carries :class:`~.rowsparse.RowSparseRows` to
+  the lazy optimizer rule. The dense ``(vocab, dim)`` gradient is never
+  materialized on that path (pinned by the cost-analysis regression in
+  tests/test_sparse_embedding.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .rowsparse import dedup_rows, densify
+
+__all__ = ["sparse_embedding", "SparseSite", "find_sites"]
+
+
+@jax.custom_vjp
+def sparse_embedding(data, weight):
+    """``weight[data]`` — same forward as dense Embedding (a gather XLA
+    lowers natively); the backward emits deduplicated rows."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+def _fwd(data, weight):
+    out = sparse_embedding(data, weight)
+    return out, (data, weight.shape[0])
+
+
+def _bwd(res, g):
+    data, vocab = res
+    rs = dedup_rows(data, g, num_rows=vocab)
+    # ids take no gradient (integer input); weight cotangent must be
+    # dense per the VJP contract but is built from the deduped rows —
+    # one (n, dim) scatter, not one per occurrence
+    return None, densify(rs).astype(g.dtype)
+
+
+sparse_embedding.defvjp(_fwd, _bwd)
+
+
+class SparseSite:
+    """One fused-step-routable SparseEmbedding node: the ids input is a
+    direct data variable (so the step can gather + perturb outside the
+    graph eval) and the weight input is a direct parameter variable."""
+
+    __slots__ = ("node", "weight_name", "ids_name", "vocab", "dim")
+
+    def __init__(self, node, weight_name, ids_name, vocab, dim):
+        self.node = node
+        self.weight_name = weight_name
+        self.ids_name = ids_name
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+
+    def describe(self):
+        """Hashable config for compile keys / reports."""
+        return (self.node.name, self.weight_name, self.ids_name,
+                self.vocab, self.dim)
+
+
+def find_sites(sym, param_names, input_names, shapes=None):
+    """Scan ``sym`` for SparseEmbedding nodes the fused step can route
+    row-sparse. A node qualifies when its ids input is a VARIABLE named
+    in ``input_names`` (a per-batch feed — computed ids would need the
+    graph to produce them first) and its weight input is a VARIABLE in
+    ``param_names``. ``shapes`` (name -> shape) resolves vocab/dim when
+    the node attrs omit them. Non-qualifying nodes simply stay on the
+    dense custom-VJP path — correct, just not rows-only.
+    """
+    from ..ops.registry import parse_attr
+    params = set(param_names)
+    inputs = set(input_names)
+    sites = []
+    for node in sym._topo_nodes():
+        if node.op != "_contrib_SparseEmbedding":
+            continue
+        if len(node.inputs) != 2:
+            continue
+        ids_node, ids_idx = node.inputs[0]
+        w_node, w_idx = node.inputs[1]
+        if ids_node.op is not None or w_node.op is not None:
+            continue
+        if ids_node.name not in inputs or w_node.name not in params:
+            continue
+        attrs = {k: parse_attr(v) for k, v in node.attrs.items()
+                 if not k.startswith("__")}
+        vocab = attrs.get("input_dim")
+        dim = attrs.get("output_dim")
+        if (vocab is None or dim is None) and shapes is not None:
+            wshape = shapes.get(w_node.name)
+            if wshape is not None and len(wshape) == 2:
+                vocab = vocab if vocab is not None else wshape[0]
+                dim = dim if dim is not None else wshape[1]
+        if vocab is None or dim is None:
+            continue
+        sites.append(SparseSite(node, w_node.name, ids_node.name,
+                                vocab, dim))
+    return sites

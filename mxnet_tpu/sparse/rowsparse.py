@@ -1,0 +1,103 @@
+"""Row-sparse gradient carrier and trace-time dedup primitives.
+
+The reference framework shipped ``row_sparse`` NDArrays (PAPER.md L3)
+precisely for embedding-dominated models: the gradient of an embedding
+lookup touches only the rows that appeared in the batch, so shipping
+(and applying) a dense ``(vocab, dim)`` gradient wastes bandwidth
+proportional to ``vocab / unique_ids`` — 10^4-10^5x on production
+vocabularies. The eager path already has ``RowSparseNDArray``
+(ndarray/sparse.py); this module is its TRACED counterpart: everything
+here is shape-static and jit-safe, so the fused train step can carry
+rows-only gradients through one donated XLA program.
+
+Shape-static dedup: XLA programs cannot have data-dependent shapes, so
+``dedup_rows`` always returns ``capacity`` rows (capacity = the id count
+of the batch, the worst case of zero duplicates). Unused slots are
+padded with a sentinel id == ``num_rows``; every consumer drops them
+structurally — gathers clip, scatters use ``mode="drop"`` — so the
+sentinel never aliases row 0 (the classic padding bug) and never costs a
+branch.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["RowSparseRows", "dedup_rows", "segment_rows", "scatter_rows",
+           "densify"]
+
+
+class RowSparseRows:
+    """A rows-touched-only gradient for one ``(num_rows, dim)`` table.
+
+    ``ids``: int32 ``(capacity,)`` — SORTED unique row ids, padded at the
+    tail with the sentinel ``num_rows``. ``rows``: ``(capacity, dim)`` —
+    the summed gradient rows aligned with ``ids`` (zero at sentinel
+    slots). A jax pytree, so it flows through jit/grad/cond unchanged.
+    """
+
+    __slots__ = ("ids", "rows", "num_rows")
+
+    def __init__(self, ids, rows, num_rows):
+        self.ids = ids
+        self.rows = rows
+        self.num_rows = int(num_rows)
+
+    def __repr__(self):
+        return (f"RowSparseRows(capacity={self.ids.shape[0]}, "
+                f"dim={self.rows.shape[-1]}, num_rows={self.num_rows})")
+
+
+jax.tree_util.register_pytree_node(
+    RowSparseRows,
+    lambda r: ((r.ids, r.rows), r.num_rows),
+    lambda num_rows, ch: RowSparseRows(ch[0], ch[1], num_rows))
+
+
+def dedup_rows(ids, values, num_rows, capacity=None):
+    """Deduplicate ``(ids, values)`` pairs into sorted-unique row sums.
+
+    ``ids``: integer array, any shape with ``n`` total elements.
+    ``values``: ``ids.shape + (dim,)`` per-occurrence rows (e.g. the
+    gradient wrt the gathered activations). Returns a
+    :class:`RowSparseRows` with ``capacity`` (default ``n``) slots:
+    duplicate ids are summed via one segment-sum, ids come out sorted,
+    tail slots carry the sentinel ``num_rows`` with zero rows.
+
+    All shapes are static — safe inside jit (``jnp.unique(size=...)``).
+    """
+    ids_flat = ids.astype(jnp.int32).reshape(-1)
+    n = ids_flat.shape[0]
+    dim = values.shape[-1]
+    vals = values.reshape(n, dim)
+    cap = int(capacity) if capacity is not None else n
+    uids = jnp.unique(ids_flat, size=cap, fill_value=num_rows)
+    # every real id is present in uids (sorted), so searchsorted is an
+    # exact position lookup, and the segment-sum below is the dedup
+    pos = jnp.searchsorted(uids, ids_flat)
+    rows = jax.ops.segment_sum(vals, pos, num_segments=cap)
+    return RowSparseRows(uids, rows, num_rows)
+
+
+def segment_rows(values, segment_ids, num_segments):
+    """Sum ``values`` rows into ``num_segments`` buckets (the dedup
+    workhorse, exposed for the op registry's gradient sweep)."""
+    return jax.ops.segment_sum(values, segment_ids.astype(jnp.int32),
+                               num_segments=int(num_segments))
+
+
+def scatter_rows(table, rs: RowSparseRows, scale=1.0):
+    """``table[rs.ids] += scale * rs.rows`` with sentinel slots dropped
+    (``mode="drop"``: an out-of-range index contributes nothing — the
+    rows-only scatter-add the lazy optimizer rules build on)."""
+    return table.at[rs.ids].add(
+        (scale * rs.rows).astype(table.dtype), mode="drop")
+
+
+def densify(rs: RowSparseRows, dim=None):
+    """Materialize the dense ``(num_rows, dim)`` gradient (test oracle /
+    op-level VJP contract — production paths never call this on a real
+    vocabulary)."""
+    d = int(dim) if dim is not None else rs.rows.shape[-1]
+    dense = jnp.zeros((rs.num_rows, d), rs.rows.dtype)
+    return dense.at[rs.ids].add(rs.rows, mode="drop")

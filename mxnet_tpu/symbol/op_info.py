@@ -20,6 +20,7 @@ OP_INPUTS = {
     "LayerNorm": (["data", "gamma", "beta"], []),
     "InstanceNorm": (["data", "gamma", "beta"], []),
     "Embedding": (["data", "weight"], []),
+    "_contrib_SparseEmbedding": (["data", "weight"], []),
     "RNN": (["data", "parameters", "state", "state_cell"], []),
     "_rnn_zero_state": (["data"], []),
     "SoftmaxOutput": (["data", "label"], []),

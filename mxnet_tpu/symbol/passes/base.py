@@ -24,7 +24,7 @@ from ... import config
 from ..symbol import Symbol, Group, _Node
 
 __all__ = ["GraphPass", "PassContext", "resolve_flag", "flag_active",
-           "rebuild_graph", "parse_node_attrs"]
+           "rebuild_graph", "parse_node_attrs", "embedding_skip_reason"]
 
 
 def resolve_flag(value) -> str:
@@ -56,10 +56,10 @@ class PassContext:
     casting to bf16 must not be double-cast by the bf16 pass)."""
 
     __slots__ = ("tag", "mode", "mesh", "compute_dtype", "shapes",
-                 "data_names")
+                 "data_names", "symbol")
 
     def __init__(self, tag, mode="train", mesh=None, compute_dtype=None,
-                 shapes=None, data_names=None):
+                 shapes=None, data_names=None, symbol=None):
         self.tag = tag
         self.mode = mode
         self.mesh = mesh
@@ -69,6 +69,11 @@ class PassContext:
         # measurement apply the same parameter-expression hoisting the
         # Predictor does, so the gate judges the program actually run
         self.data_names = set(data_names) if data_names else None
+        # the CURRENT graph (manager updates it pass-by-pass): prechecks
+        # that depend on graph content — not just bind context — scan it
+        # instead of crashing inside apply/measure on shapes they can't
+        # handle (e.g. integer-id embedding inputs)
+        self.symbol = symbol
 
 
 class GraphPass:
@@ -112,6 +117,27 @@ class GraphPass:
 
     def apply(self, sym, shapes, ctx):  # pragma: no cover - interface
         raise NotImplementedError
+
+
+_EMBEDDING_OPS = frozenset({"Embedding", "_contrib_SparseEmbedding"})
+
+
+def embedding_skip_reason(ctx: PassContext) -> Optional[str]:
+    """Counted skip for embedding graphs (round 13). The conv-era
+    rewrites have nothing to fuse/fold in a lookup-dominated graph, the
+    bf16 cast must not down-cast an embedding table (the table IS the
+    model), and the bytes-gate measurement builds float32 inputs for
+    every variable — feeding float ids to a gather trace would crash,
+    not skip. Returning ``"embedding_graph"`` here makes the no-fire an
+    explicit, counted decision (``passes::skipped::embedding_graph``)
+    instead of a silent bail or an integer-dtype crash."""
+    sym = getattr(ctx, "symbol", None)
+    if sym is None:
+        return None
+    for node in sym._topo_nodes():
+        if node.op in _EMBEDDING_OPS:
+            return "embedding_graph"
+    return None
 
 
 def parse_node_attrs(node) -> dict:

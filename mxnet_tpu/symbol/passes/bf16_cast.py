@@ -43,6 +43,13 @@ class Bf16CastPass(GraphPass):
     modes = ("train", "infer", "serving")
 
     def precheck(self, ctx):
+        from .base import embedding_skip_reason
+        reason = embedding_skip_reason(ctx)
+        if reason:
+            # an embedding table must stay fp32: casting the table IS
+            # casting the model (unlike conv weights, there is no
+            # per-step master copy on the serving path)
+            return reason
         if ctx.compute_dtype is not None and \
                 str(ctx.compute_dtype) not in ("float32", "None"):
             return f"compute_dtype={ctx.compute_dtype}"

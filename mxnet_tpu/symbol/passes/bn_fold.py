@@ -43,6 +43,10 @@ class BNFoldPass(GraphPass):
     mesh_safe = True
     modes = ("train", "infer", "serving")
 
+    def precheck(self, ctx):
+        from .base import embedding_skip_reason
+        return embedding_skip_reason(ctx)
+
     def apply(self, sym, shapes, ctx):
         _, node_shapes = sym._propagate_shapes(dict(shapes))
         nodes = sym._topo_nodes()

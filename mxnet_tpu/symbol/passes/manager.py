@@ -215,7 +215,8 @@ class PassManager:
                 entry["status"] = "inapplicable"
                 entry["reason"] = f"mode:{mode}"
                 continue
-            reason = p.precheck(ctx)
+            ctx.symbol = cur     # graph-content prechecks see the
+            reason = p.precheck(ctx)  # CURRENT (possibly rewritten) graph
             if reason:
                 self._skip(entry, p, reason)
                 continue

@@ -21,6 +21,10 @@ class PallasFusionPass(GraphPass):
     mesh_safe = False          # GSPMD can't partition the custom call
     modes = ("train", "infer", "serving")
 
+    def precheck(self, ctx):
+        from .base import embedding_skip_reason
+        return embedding_skip_reason(ctx)
+
     def apply(self, sym, shapes, ctx):
         from ..fusion import fuse_symbol
         new_sym, rep = fuse_symbol(sym, shapes)

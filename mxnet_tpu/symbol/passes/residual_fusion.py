@@ -133,6 +133,10 @@ class ResidualFusionPass(GraphPass):
     mesh_safe = False          # composes with pallas_fusion's sites;
     modes = ("train", "infer", "serving")  # mesh fusion is ROADMAP it.1
 
+    def precheck(self, ctx):
+        from .base import embedding_skip_reason
+        return embedding_skip_reason(ctx)
+
     def apply(self, sym, shapes, ctx):
         sites, report = match_bn_relu_conv(sym, shapes,
                                            _conv_general_matches)

@@ -277,10 +277,12 @@ class Symbol:
         return list(self._group) if self._group is not None else [self]
 
     def eval_arrays(self, arg_arrays: Dict[str, "np.ndarray"],
-                    training=False, rng_key=None, device_map=None):
+                    training=False, rng_key=None, device_map=None,
+                    preset=None):
         """Evaluate outputs given raw arrays for every variable."""
         outs, _ = self.eval_arrays_ex(arg_arrays, training, rng_key,
-                                      device_map=device_map)
+                                      device_map=device_map,
+                                      preset=preset)
         return outs
 
     def build_device_map(self, group2ctx, default_device=None):
@@ -836,7 +838,7 @@ def _hint_param_shapes(node, in_shapes, attrs):
         c = data_shape[axis]
         want = {"gamma": (c,), "beta": (c,), "moving_mean": (c,),
                 "moving_var": (c,)}
-    elif node.op == "Embedding":
+    elif node.op in ("Embedding", "_contrib_SparseEmbedding"):
         want = {"weight": (int(attrs.get("input_dim")),
                            int(attrs.get("output_dim")))}
     elif node.op in ("SoftmaxOutput", "Softmax", "SVMOutput"):

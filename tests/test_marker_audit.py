@@ -197,3 +197,30 @@ def test_serving_fast_paths_stay_in_tier1():
     assert m and "slow" not in m.group(0), (
         "test_serving.py's module-level pytestmark must not include "
         "slow — the functional serving cases are tier-1 coverage")
+
+
+def test_sparse_embedding_suite_stays_tier1_with_chaos_marked():
+    """The sparse-embedding suite is tier-1's only proof that the
+    row-sparse train path is bit-identical to dense under full coverage
+    and that the 100k-vocab step moves strictly fewer bytes. It must
+    (a) exist, (b) never carry a module-wide or per-case ``slow`` mark
+    that would drop those pins from the gate, and (c) mark its
+    kill-mid-update resume drill ``chaos`` so ``-m chaos`` selects the
+    whole fault surface."""
+    path = os.path.join(_TESTS, "test_sparse_embedding.py")
+    assert os.path.exists(path), "tests/test_sparse_embedding.py missing"
+    with open(path) as f:
+        src = f.read()
+    m = re.search(r"^pytestmark\s*=.*$", src, re.M)
+    assert m is None or "slow" not in m.group(0), (
+        "test_sparse_embedding.py must stay tier-1: a module-level "
+        "slow mark drops the sparse-vs-dense equivalence pins from "
+        "the gate")
+    uses = _mark_uses()
+    assert "test_sparse_embedding.py" not in uses.get("slow", set()), (
+        "test_sparse_embedding.py cases must not be slow-marked — the "
+        "grad-bytes regression and sharded-update isolation are "
+        "tier-1 acceptance pins")
+    assert "test_sparse_embedding.py" in uses.get("chaos", set()), (
+        "the SIGKILL-mid-sparse-update resume drill must carry "
+        "pytest.mark.chaos like the other fault-injection suites")

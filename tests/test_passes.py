@@ -759,3 +759,99 @@ def test_resnet50_full_pipeline_bytes_strictly_below():
     base = P.measure_symbol_bytes(net, shapes, mode="train")
     full = P.measure_symbol_bytes(final, shapes, mode="train")
     assert base and full and full < base
+
+
+# ---------------------------------------------------------------------------
+# embedding graphs: counted no-fire (round 13)
+# ---------------------------------------------------------------------------
+def _embedding_net(op="Embedding", vocab=50, dim=8):
+    data = mx.sym.Variable("data")
+    emb = getattr(mx.sym, op)(data=data, input_dim=vocab, output_dim=dim,
+                              name="emb")
+    fc = mx.sym.FullyConnected(mx.sym.Flatten(emb), num_hidden=4,
+                               name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def _embedding_shapes(net, batch=4, slen=2):
+    kw = {"data": (batch, slen), "softmax_label": (batch,)}
+    arg_shapes, _, aux_shapes = net.infer_shape(**kw)
+    shapes = dict(zip(net.list_arguments(), arg_shapes))
+    shapes.update(zip(net.list_auxiliary_states(), aux_shapes))
+    return shapes
+
+
+@pytest.mark.parametrize("op", ["Embedding", "_contrib_SparseEmbedding"])
+def test_embedding_graph_skips_are_counted_not_crashes(op):
+    """Adversarial: every pass forced ON (bytes gate too) against a
+    lookup-dominated graph with integer ids. The conv-era rewrites have
+    nothing to fuse there, and the bytes-gate measurement would feed
+    float ids to a gather — the manager must record a counted
+    'embedding_graph' skip per pass, never fire, and never crash."""
+    from mxnet_tpu.telemetry import registry as treg
+    net = _embedding_net(op)
+    shapes = _embedding_shapes(net)
+    before = treg.counter("passes::skipped::embedding_graph").get()
+    with _flags(MXTPU_PALLAS_FUSION="1", MXTPU_PASS_RESIDUAL_FUSION="1",
+                MXTPU_PASS_BN_FOLD="1", MXTPU_PASS_BF16="1"):
+        with mx.config.override("MXTPU_PASS_GATE_BYTES", "1"):
+            final, rep = P.apply_pipeline(net, shapes, tag="fused_step",
+                                          mode="train")
+    assert final is None, "no pass may rewrite an embedding graph"
+    for e in rep["passes"]:
+        assert e["status"] == "skipped", (e["pass"], e["status"],
+                                          e["reason"])
+        assert e["reason"] == "embedding_graph"
+    assert treg.counter("passes::skipped::embedding_graph").get() \
+        >= before + 4
+    rp = mx.pass_report()
+    assert any(s["reason"] == "embedding_graph"
+               for s in rp["skipped"])
+
+
+def test_embedding_skip_reason_leaves_conv_graphs_alone():
+    """The precheck is content-driven: the same forced-on pipeline
+    still fires on a conv graph in the same process."""
+    net = _block3x3()
+    with _flags(MXTPU_PALLAS_FUSION="1", MXTPU_PASS_RESIDUAL_FUSION="1"):
+        final, rep = P.apply_pipeline(net, _shapes_for(net), tag="t",
+                                      mode="train")
+    assert final is not None
+    assert any(e["status"] == "applied" for e in rep["passes"])
+
+
+def test_sparse_embedding_module_trains_with_passes_forced_on():
+    """End to end: a SparseEmbedding module binds and trains with the
+    whole pipeline forced on — the fused step routes the row-sparse
+    path while the passes no-fire as counted skips."""
+    from mxnet_tpu.io import DataBatch
+    import mxnet_tpu.ndarray as nd
+    net = _embedding_net("_contrib_SparseEmbedding")
+    rng = np.random.RandomState(0)
+    with _flags(MXTPU_PALLAS_FUSION="1", MXTPU_PASS_RESIDUAL_FUSION="1",
+                MXTPU_PASS_BN_FOLD="1", MXTPU_PASS_BF16="1"):
+        mod = mx.mod.Module(net, data_names=("data",),
+                            label_names=("softmax_label",),
+                            context=mx.cpu())
+        mod.bind(data_shapes=[("data", (4, 2))],
+                 label_shapes=[("softmax_label", (4,))])
+        mod.init_params(mx.init.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        for _ in range(2):
+            b = DataBatch(
+                data=[nd.array(rng.randint(0, 50, (4, 2))
+                               .astype(np.int32))],
+                label=[nd.array(rng.randint(0, 4, (4,))
+                                .astype(np.float32))])
+            mod.forward(b, is_train=True)
+            mod.backward()
+            mod.update()
+        assert len(mod._fused._sparse_sites) == 1
+        skipped = [e for e in mod._fused.pass_report["passes"]
+                   if e["status"] == "skipped"]
+        assert skipped and all(e["reason"] == "embedding_graph"
+                               for e in skipped)
+    args, _ = mod.get_params()
+    emb = np.asarray(args["emb_weight"]._data)
+    assert np.isfinite(emb).all()

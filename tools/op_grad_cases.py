@@ -103,6 +103,13 @@ CASES = {
         inputs=[np.array([[0, 2], [1, 3]], np.int32),
                 _signed((4, 5), 1)],
         attrs=dict(input_dim=4, output_dim=5), grad_args=[1]),
+    "_contrib_sparse_segment_sum": dict(
+        # row-gradient reducer behind SparseEmbedding's backward
+        # (sparse/rowsparse.py); ids take no gradient, data does —
+        # segment 2 left empty to pin the zero-row path
+        inputs=[_signed((6, 4), 0),
+                np.array([0, 1, 0, 3, 1, 0], np.int32)],
+        attrs=dict(num_segments=4), grad_args=[0]),
     "RNN": dict(
         inputs=[_signed((4, 2, 3), 0),            # (T,N,C)
                 _signed((4 * 5 * (3 + 5 + 2),), 1),  # lstm flat params
