@@ -222,8 +222,15 @@ class FusedSymbolStep:
         self._sparse_sites = []
         from ..sparse.embedding import find_sites as _find_sites
         from ..telemetry import registry as _treg
+        tied = []
         all_sites = _find_sites(run_sym, self.param_names,
-                                self.input_names, shapes)
+                                self.input_names, shapes,
+                                fallbacks=tied)
+        if tied:
+            # tables with a non-site consumer (tied weights): routing
+            # them row-sparse would drop the other consumer's gradient,
+            # so they stay on the dense custom-VJP path, counted
+            _treg.counter("sparse::dense_fallback").inc(len(tied))
         if all_sites and self._fopt.row_update is None:
             _treg.counter("sparse::dense_fallback").inc(len(all_sites))
         elif all_sites:

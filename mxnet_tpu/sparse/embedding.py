@@ -72,7 +72,8 @@ class SparseSite:
                 self.vocab, self.dim)
 
 
-def find_sites(sym, param_names, input_names, shapes=None):
+def find_sites(sym, param_names, input_names, shapes=None,
+               fallbacks=None):
     """Scan ``sym`` for SparseEmbedding nodes the fused step can route
     row-sparse. A node qualifies when its ids input is a VARIABLE named
     in ``input_names`` (a per-batch feed — computed ids would need the
@@ -80,12 +81,34 @@ def find_sites(sym, param_names, input_names, shapes=None):
     ``param_names``. ``shapes`` (name -> shape) resolves vocab/dim when
     the node attrs omit them. Non-qualifying nodes simply stay on the
     dense custom-VJP path — correct, just not rows-only.
+
+    Tied-weight safety: the fused step replaces a routed site's table
+    with a NON-differentiated constant inside its loss trace, so the
+    gather-path rows are the ONLY gradient the table ever receives. A
+    table is therefore routed only when every consumer of the weight
+    variable in ``sym`` is itself a qualifying SparseEmbedding node
+    consuming it at the weight position (several sites may share one
+    table — their row gradients merge). A weight that also feeds any
+    other node (tied input/output embeddings, a dense op) or is itself
+    a graph output stays wholesale on the dense custom-VJP path, where
+    every consumer's contribution flows; each excluded site is appended
+    to ``fallbacks`` (if given, a list collecting ``{"weight", "node",
+    "reason"}`` dicts) so callers can count the dense fallback.
     """
     from ..ops.registry import parse_attr
     params = set(param_names)
     inputs = set(input_names)
-    sites = []
-    for node in sym._topo_nodes():
+    nodes = sym._topo_nodes()
+    # every (consumer node, input position) of each parameter variable
+    consumers = {}
+    for node in nodes:
+        for pos, (p, _) in enumerate(node.inputs):
+            if p.op is None and p.name in params:
+                consumers.setdefault(p.name, []).append((node, pos))
+    out_vars = {s._node.name for s in sym._output_symbols()
+                if s._node.op is None}
+    candidates = []
+    for node in nodes:
         if node.op != "_contrib_SparseEmbedding":
             continue
         if len(node.inputs) != 2:
@@ -107,6 +130,19 @@ def find_sites(sym, param_names, input_names, shapes=None):
                 dim = dim if dim is not None else wshape[1]
         if vocab is None or dim is None:
             continue
-        sites.append(SparseSite(node, w_node.name, ids_node.name,
-                                vocab, dim))
+        candidates.append(SparseSite(node, w_node.name, ids_node.name,
+                                     vocab, dim))
+    qualifying = {id(s.node) for s in candidates}
+    sites = []
+    for s in candidates:
+        tied = s.weight_name in out_vars or any(
+            id(n) not in qualifying or pos != 1
+            for n, pos in consumers.get(s.weight_name, ()))
+        if tied:
+            if fallbacks is not None:
+                fallbacks.append({"weight": s.weight_name,
+                                  "node": s.node.name,
+                                  "reason": "shared_weight"})
+            continue
+        sites.append(s)
     return sites

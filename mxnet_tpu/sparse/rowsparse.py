@@ -65,12 +65,32 @@ def dedup_rows(ids, values, num_rows, capacity=None):
     tail slots carry the sentinel ``num_rows`` with zero rows.
 
     All shapes are static — safe inside jit (``jnp.unique(size=...)``).
+
+    ``capacity`` MUST be >= the true unique-id count of the batch: a
+    smaller cap makes ``jnp.unique(size=cap)`` keep only the first
+    ``cap`` sorted uniques, and the gradient rows of every larger id
+    are silently dropped by the segment-sum (searchsorted maps them
+    past the last slot). The default, ``capacity = n`` (zero-duplicate
+    worst case), is always safe; pass an explicit cap only as a known
+    upper bound on unique ids, never as a memory-tuning knob. Outside
+    a trace (concrete ids) an undersized cap raises instead of
+    truncating; inside jit the ids are abstract and the contract is
+    the caller's to uphold.
     """
     ids_flat = ids.astype(jnp.int32).reshape(-1)
     n = ids_flat.shape[0]
     dim = values.shape[-1]
     vals = values.reshape(n, dim)
     cap = int(capacity) if capacity is not None else n
+    if capacity is not None and cap < n and \
+            not isinstance(ids_flat, jax.core.Tracer):
+        uniq = int(jnp.unique(ids_flat).size)
+        if uniq > cap:
+            raise ValueError(
+                f"dedup_rows: capacity={cap} is below the {uniq} unique "
+                f"ids in the batch — the largest ids' gradient rows "
+                f"would be silently dropped. Use capacity >= the unique "
+                f"count (the default, capacity=n={n}, is always safe).")
     uids = jnp.unique(ids_flat, size=cap, fill_value=num_rows)
     # every real id is present in uids (sorted), so searchsorted is an
     # exact position lookup, and the segment-sum below is the dedup

@@ -120,23 +120,39 @@ class GraphPass:
 
 
 _EMBEDDING_OPS = frozenset({"Embedding", "_contrib_SparseEmbedding"})
+# conv-family anchors the four rewrites pattern-match around; the fused
+# composites count so a later pass in the pipeline still sees a conv
+# tower after an earlier pass rewrote the plain Convolution nodes
+_CONV_ANCHOR_OPS = frozenset({"Convolution", "Convolution_v1",
+                              "_FusedBNReLUConv", "_FusedBNReLUConvK"})
 
 
 def embedding_skip_reason(ctx: PassContext) -> Optional[str]:
-    """Counted skip for embedding graphs (round 13). The conv-era
-    rewrites have nothing to fuse/fold in a lookup-dominated graph, the
-    bf16 cast must not down-cast an embedding table (the table IS the
-    model), and the bytes-gate measurement builds float32 inputs for
-    every variable — feeding float ids to a gather trace would crash,
-    not skip. Returning ``"embedding_graph"`` here makes the no-fire an
-    explicit, counted decision (``passes::skipped::embedding_graph``)
-    instead of a silent bail or an integer-dtype crash."""
+    """Counted skip for lookup-dominated graphs (round 13). The
+    conv-era rewrites have nothing to fuse/fold/cast in a graph with no
+    Convolution anchor, so an embedding graph WITHOUT convs no-fires as
+    an explicit, counted decision (``passes::skipped::embedding_graph``)
+    instead of a silent ``no_match`` — the adversarial cases in
+    tests/test_passes.py pin this.
+
+    Scoped to embedding-ONLY graphs: a MIXED graph (conv/BN backbone
+    plus an embedding lookup — the two-tower example's dense towers)
+    keeps every rewrite; the matchers anchor on Convolution/BatchNorm
+    nodes and never touch the lookup or its table, and the bytes-gate
+    measurement synthesizes int32 for embedding id feeds
+    (passes/manager.py), so integer inputs no longer make the proxy
+    unmeasurable."""
     sym = getattr(ctx, "symbol", None)
     if sym is None:
         return None
+    has_emb = has_conv = False
     for node in sym._topo_nodes():
         if node.op in _EMBEDDING_OPS:
-            return "embedding_graph"
+            has_emb = True
+        elif node.op in _CONV_ANCHOR_OPS:
+            has_conv = True
+    if has_emb and not has_conv:
+        return "embedding_graph"
     return None
 
 

@@ -46,9 +46,10 @@ class Bf16CastPass(GraphPass):
         from .base import embedding_skip_reason
         reason = embedding_skip_reason(ctx)
         if reason:
-            # an embedding table must stay fp32: casting the table IS
-            # casting the model (unlike conv weights, there is no
-            # per-step master copy on the serving path)
+            # lookup-only graph: nothing on the Convolution allowlist.
+            # Mixed graphs pass through — the allowlist never touches
+            # an embedding table, so it stays fp32 (the table IS the
+            # model; there is no per-step master copy on serving)
             return reason
         if ctx.compute_dtype is not None and \
                 str(ctx.compute_dtype) not in ("float32", "None"):

@@ -109,6 +109,30 @@ def measure_symbol_bytes(sym, shapes, mode="train", data_names=None):
     return val
 
 
+def _integer_feed_names(sym):
+    """Variable names consumed as embedding ids (the ids input of
+    ``Embedding``/``_contrib_SparseEmbedding``, looked through
+    Reshape/Flatten/Cast chains). The bytes proxy synthesizes int32 for
+    them: float ids would trace a cast-inserting program the real bind
+    never runs, and ``jax.grad`` cannot differentiate wrt integer args,
+    so the train proxy also excludes them from its argnums. Computed
+    ids (a non-pass-through producer) resolve to no variable and keep
+    the plain float32 synthesis."""
+    _PASS_THROUGH = ("Reshape", "reshape", "Flatten", "flatten", "Cast",
+                     "cast")
+    names = set()
+    for node in sym._topo_nodes():
+        if node.op not in ("Embedding", "_contrib_SparseEmbedding") \
+                or not node.inputs:
+            continue
+        p, _ = node.inputs[0]
+        while p.op in _PASS_THROUGH and p.inputs:
+            p = p.inputs[0][0]
+        if p.op is None:
+            names.add(p.name)
+    return names
+
+
 def _measure(sym, shapes, kind, data_names=None):
     import numpy as np
     try:
@@ -118,9 +142,11 @@ def _measure(sym, shapes, kind, data_names=None):
         aux_names = sym.list_auxiliary_states()
         if any(n not in shapes for n in arg_names + aux_names):
             return None
+        int_names = _integer_feed_names(sym)
 
         def sds(n):
-            return jax.ShapeDtypeStruct(tuple(shapes[n]), np.float32)
+            dt = np.int32 if n in int_names else np.float32
+            return jax.ShapeDtypeStruct(tuple(shapes[n]), dt)
 
         if kind == "infer" and data_names:
             from .hoist import hoist_plan, hoist_values
@@ -147,7 +173,21 @@ def _measure(sym, shapes, kind, data_names=None):
             arg_s = tuple(sds(n) for n in arg_names)
             aux_s = tuple(sds(n) for n in aux_names)
             fwd, fwd_loss, _ = build_graph_fns(sym)
-            if kind == "train":
+            if kind == "train" and int_names:
+                # differentiate wrt the float args only — integer id
+                # feeds take no gradient and jax.grad rejects int dtypes
+                fidx = [i for i, n in enumerate(arg_names)
+                        if n not in int_names]
+
+                def fn(arg_vals, aux_vals, key):
+                    def loss(fvals):
+                        full = list(arg_vals)
+                        for j, i in enumerate(fidx):
+                            full[i] = fvals[j]
+                        return fwd_loss(tuple(full), aux_vals, None, key)
+                    return jax.grad(loss, has_aux=True)(
+                        tuple(arg_vals[i] for i in fidx))
+            elif kind == "train":
                 def fn(arg_vals, aux_vals, key):
                     return jax.grad(fwd_loss, argnums=0, has_aux=True)(
                         arg_vals, aux_vals, None, key)

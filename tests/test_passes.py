@@ -809,6 +809,62 @@ def test_embedding_graph_skips_are_counted_not_crashes(op):
                for s in rp["skipped"])
 
 
+def _mixed_net(op="Embedding", vocab=50, dim=8):
+    """Conv/BN dense tower + embedding lookup tower, concatenated — the
+    two-tower shape: the conv-era rewrites must keep firing here."""
+    img = mx.sym.Variable("img")
+    bn = mx.sym.BatchNorm(img, name="bn1", fix_gamma=False)
+    a = mx.sym.Activation(bn, act_type="relu", name="relu1")
+    conv = mx.sym.Convolution(a, kernel=(1, 1), num_filter=8,
+                              no_bias=True, name="conv1")
+    pooled = mx.sym.Pooling(conv, global_pool=True, kernel=(1, 1),
+                            pool_type="avg", name="pool")
+    ids = mx.sym.Variable("ids")
+    emb = getattr(mx.sym, op)(data=ids, input_dim=vocab, output_dim=dim,
+                              name="emb")
+    cat = mx.sym.Concat(mx.sym.Flatten(pooled), mx.sym.Flatten(emb),
+                        dim=1)
+    fc = mx.sym.FullyConnected(cat, num_hidden=4, name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def _mixed_shapes(net):
+    kw = {"img": (4, 8, 8, 8), "ids": (4, 2), "softmax_label": (4,)}
+    arg_shapes, _, aux_shapes = net.infer_shape(**kw)
+    shapes = dict(zip(net.list_arguments(), arg_shapes))
+    shapes.update(zip(net.list_auxiliary_states(), aux_shapes))
+    return shapes
+
+
+@pytest.mark.parametrize("op", ["Embedding", "_contrib_SparseEmbedding"])
+def test_mixed_conv_embedding_graph_keeps_rewrites(op):
+    """The embedding guard is scoped to lookup-ONLY graphs: a mixed
+    conv+embedding graph (two-tower dense towers) must not lose the
+    conv-era rewrites wholesale. With the bytes gate forced on, the
+    measurement synthesizes int32 id feeds, so the pipeline measures
+    and fires on the float portion while the lookup survives
+    untouched."""
+    net = _mixed_net(op)
+    shapes = _mixed_shapes(net)
+    # the bytes proxy itself must be measurable with integer id feeds
+    assert P.measure_symbol_bytes(net, shapes, mode="train") is not None
+    with _flags(MXTPU_PALLAS_FUSION="1", MXTPU_PASS_RESIDUAL_FUSION="1",
+                MXTPU_PASS_BN_FOLD="1", MXTPU_PASS_BF16="1"):
+        with mx.config.override("MXTPU_PASS_GATE_BYTES", "1"):
+            final, rep = P.apply_pipeline(net, shapes, tag="fused_step",
+                                          mode="train")
+    assert all(e["reason"] != "embedding_graph" for e in rep["passes"]), \
+        "mixed graphs must not take the embedding_graph skip"
+    fired = [e for e in rep["passes"] if e["status"] == "applied"]
+    assert fired, "at least one conv rewrite must fire on the conv tower"
+    assert all(e["bytes_before"] is not None and
+               e["bytes_after"] < e["bytes_before"] for e in fired), \
+        "forced gate must measure the int-id graph and strictly reduce"
+    assert final is not None
+    assert any(n.op == op for n in final._topo_nodes()), \
+        "the lookup node must survive every rewrite untouched"
+
+
 def test_embedding_skip_reason_leaves_conv_graphs_alone():
     """The precheck is content-driven: the same forced-on pipeline
     still fires on a conv graph in the same process."""
@@ -818,6 +874,45 @@ def test_embedding_skip_reason_leaves_conv_graphs_alone():
                                       mode="train")
     assert final is not None
     assert any(e["status"] == "applied" for e in rep["passes"])
+
+
+def test_mixed_module_routes_sparse_and_fires_passes():
+    """End to end on the mixed graph: the fused step routes the
+    embedding row-sparse AND the conv tower keeps its rewrite — the
+    two subsystems compose instead of the guard trading one for the
+    other."""
+    from mxnet_tpu.io import DataBatch
+    import mxnet_tpu.ndarray as nd
+    net = _mixed_net("_contrib_SparseEmbedding")
+    rng = np.random.RandomState(0)
+    with _flags(MXTPU_PALLAS_FUSION="1"):
+        mod = mx.mod.Module(net, data_names=("img", "ids"),
+                            label_names=("softmax_label",),
+                            context=mx.cpu())
+        mod.bind(data_shapes=[("img", (4, 8, 8, 8)), ("ids", (4, 2))],
+                 label_shapes=[("softmax_label", (4,))])
+        mod.init_params(mx.init.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        for _ in range(2):
+            b = DataBatch(
+                data=[nd.array(rng.randn(4, 8, 8, 8)
+                               .astype(np.float32)),
+                      nd.array(rng.randint(0, 50, (4, 2))
+                               .astype(np.int32))],
+                label=[nd.array(rng.randint(0, 4, (4,))
+                                .astype(np.float32))])
+            mod.forward(b, is_train=True)
+            mod.backward()
+            mod.update()
+        assert len(mod._fused._sparse_sites) == 1
+        applied = [e for e in mod._fused.pass_report["passes"]
+                   if e["status"] == "applied"]
+        assert any(e["pass"] == "pallas_fusion" for e in applied)
+        assert all(e["reason"] != "embedding_graph"
+                   for e in mod._fused.pass_report["passes"])
+    args, _ = mod.get_params()
+    assert np.isfinite(np.asarray(args["emb_weight"]._data)).all()
 
 
 def test_sparse_embedding_module_trains_with_passes_forced_on():

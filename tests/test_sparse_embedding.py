@@ -105,6 +105,19 @@ class TestDedupRows:
         rs2 = jax.tree_util.tree_unflatten(treedef, leaves)
         assert isinstance(rs2, RowSparseRows) and rs2.num_rows == 4
 
+    def test_undersized_capacity_raises_eagerly(self):
+        """capacity below the true unique count would silently drop the
+        largest ids' rows inside a trace; on concrete ids it must raise
+        instead (the documented capacity >= unique-count contract)."""
+        ids = jnp.array([0, 3, 7, 9], jnp.int32)
+        vals = jnp.ones((4, 2), jnp.float32)
+        with pytest.raises(ValueError, match="capacity=2 is below"):
+            dedup_rows(ids, vals, num_rows=10, capacity=2)
+        # a cap that does cover the uniques is fine
+        rs = dedup_rows(jnp.array([5, 5, 5, 1], jnp.int32), vals,
+                        num_rows=10, capacity=2)
+        np.testing.assert_array_equal(np.asarray(rs.ids), [1, 5])
+
 
 # ---------------------------------------------------------------------------
 # op level: forward + VJP vs dense Embedding
@@ -284,6 +297,110 @@ class TestFusedEquivalence:
             fused._sparse_sites = sites
         assert key.digest != key_dense.digest
         assert "extra" in key.diff(key_dense)
+
+
+# ---------------------------------------------------------------------------
+# tied table weights: multi-consumer safety
+# ---------------------------------------------------------------------------
+def _tied_net(op, vocab, dim):
+    """Input/output-tied embeddings: ONE table variable feeds the
+    lookup AND the softmax projection (the classic tied decoder) — a
+    weight with a non-site consumer must never route row-sparse."""
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("emb_weight")
+    emb = getattr(mx.sym, op)(data=data, weight=w, input_dim=vocab,
+                              output_dim=dim, name="emb")
+    logits = mx.sym.FullyConnected(mx.sym.Flatten(emb), weight=w,
+                                   num_hidden=vocab, no_bias=True,
+                                   name="dec")
+    return mx.sym.SoftmaxOutput(logits, name="softmax")
+
+
+class TestTiedWeightFallback:
+    VOCAB, DIM = 10, 5
+
+    def test_find_sites_excludes_multi_consumer_weight(self):
+        from mxnet_tpu.sparse import find_sites
+        net = _tied_net("_contrib_SparseEmbedding", self.VOCAB, self.DIM)
+        fb = []
+        sites = find_sites(net, ["emb_weight"],
+                           ["data", "softmax_label"], fallbacks=fb)
+        assert sites == [], \
+            "a table also feeding a dense op must stay on the dense path"
+        assert fb == [{"weight": "emb_weight", "node": "emb",
+                       "reason": "shared_weight"}]
+
+    def test_two_qualifying_sites_sharing_table_still_route(self):
+        """Several sites over ONE table are fine — the fused step merges
+        their rows before one dedup; only a NON-site consumer trips the
+        fallback."""
+        from mxnet_tpu.sparse import find_sites
+        a, b = mx.sym.Variable("ids_a"), mx.sym.Variable("ids_b")
+        w = mx.sym.Variable("emb_weight")
+        e1 = mx.sym._contrib_SparseEmbedding(
+            data=a, weight=w, input_dim=self.VOCAB, output_dim=self.DIM,
+            name="ea")
+        e2 = mx.sym._contrib_SparseEmbedding(
+            data=b, weight=w, input_dim=self.VOCAB, output_dim=self.DIM,
+            name="eb")
+        fc = mx.sym.FullyConnected(mx.sym.Flatten(e1 + e2),
+                                   num_hidden=4, name="fc")
+        net = mx.sym.SoftmaxOutput(fc, name="softmax")
+        fb = []
+        sites = find_sites(net, ["emb_weight", "fc_weight", "fc_bias"],
+                           ["ids_a", "ids_b", "softmax_label"],
+                           fallbacks=fb)
+        assert len(sites) == 2 and not fb
+
+    def _train(self, op, ids_steps, labels):
+        mod = mx.mod.Module(_tied_net(op, self.VOCAB, self.DIM),
+                            data_names=("data",),
+                            label_names=("softmax_label",),
+                            context=mx.cpu())
+        mod.bind(data_shapes=[("data", ids_steps[0].shape)],
+                 label_shapes=[("softmax_label", labels.shape)])
+        mod.init_params()
+        w0 = (np.random.RandomState(7).randn(self.VOCAB, self.DIM)
+              * 0.1).astype(np.float32)
+        mod.set_params({"emb_weight": mx.nd.array(w0)}, {},
+                       allow_missing=True)
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.5,
+                                             "momentum": 0.9})
+        for ids in ids_steps:
+            batch = DataBatch(data=[nd.array(ids)],
+                              label=[nd.array(labels)])
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+        args, _ = mod.get_params()
+        return mod, np.asarray(args["emb_weight"]._data)
+
+    def test_tied_weight_trains_identical_to_dense(self):
+        """The review regression: before the consumer check, the fused
+        step routed the tied table row-sparse and silently dropped the
+        projection path's gradient. The tied sparse net must train
+        exactly like the tied dense-Embedding net (both on the dense
+        custom-VJP path), with the fallback counted."""
+        from mxnet_tpu.telemetry import registry as treg
+        rng = np.random.RandomState(0)
+        ids_steps = [rng.randint(0, self.VOCAB, (6, 1)).astype(np.int32)
+                     for _ in range(3)]
+        labels = rng.randint(0, self.VOCAB, (6,)).astype(np.float32)
+        before = treg.counter("sparse::dense_fallback").get()
+        sp_mod, sp = self._train("_contrib_SparseEmbedding", ids_steps,
+                                 labels)
+        dn_mod, dn = self._train("Embedding", ids_steps, labels)
+        assert len(sp_mod._fused._sparse_sites) == 0, \
+            "tied table must not be routed row-sparse"
+        assert treg.counter("sparse::dense_fallback").get() >= before + 1
+        np.testing.assert_array_equal(sp, dn, err_msg=(
+            "tied-weight sparse training diverged from the dense path — "
+            "a consumer's gradient was dropped"))
+        # and the table really moved (the test isn't vacuous)
+        w0 = (np.random.RandomState(7).randn(self.VOCAB, self.DIM)
+              * 0.1).astype(np.float32)
+        assert np.abs(sp - w0).max() > 1e-4
 
 
 # ---------------------------------------------------------------------------
