@@ -766,6 +766,29 @@ print("BENCH " + json.dumps({
     except Exception:
         pass
 
+    # -- HBM accounting (round 14): per-program peaks + process peak
+    # from the compile registry's recorded memory_analysis — the
+    # baseline `tools/telemetry.py diff --gate-peak-mem` compares
+    memory_stats = None
+    try:
+        mem = mx.memory_report()
+        proc = mem.get("process", {})
+        memory_stats = {
+            "process_peak_bytes": proc.get("peak_bytes"),
+            "donation_saved_bytes": proc.get("donation_saved_bytes"),
+            "programs": proc.get("programs"),
+            "top_programs": [
+                {"name": p["name"], "peak_bytes": p["peak_bytes"]}
+                for p in mem.get("programs", [])[:8]],
+            "note": "XLA memory_analysis() of every program this run "
+                    "compiled, recorded at compile time (zero extra "
+                    "lowering); process_peak_bytes = largest single "
+                    "program peak, donation_saved_bytes = HBM the "
+                    "buffer-donation aliasing avoids re-allocating",
+        }
+    except Exception:
+        pass
+
     # -- telemetry snapshot: the full unified report rides the BENCH
     # JSON, so every BENCH_rNN.json doubles as a bytes-regression
     # baseline for `tools/telemetry.py diff --gate-bytes` (the r6
@@ -848,6 +871,7 @@ print("BENCH " + json.dumps({
         "input_pipeline": ip_stats,
         "cold_start": cold_start,
         "sparse_embedding": sparse_stats,
+        "memory": memory_stats,
         "telemetry": telemetry_snapshot,
         "host_decode_note": "multiprocess RecordIO->decode->augment->"
                             "batch rate on 480-short-side packed records, "

@@ -53,6 +53,7 @@ from . import module as mod
 from . import monitor
 from . import monitor as mon
 from . import telemetry
+from .telemetry import memory_report
 from . import profiler
 from . import rtc
 from . import config

@@ -52,7 +52,8 @@ class ProgramRecord:
 
     __slots__ = ("name", "kind", "digest", "compiles", "cache_hits",
                  "cache_misses", "cache_errors", "compile_s", "load_s",
-                 "serialize_s", "serialized", "arg_sig", "source")
+                 "serialize_s", "serialized", "arg_sig", "source",
+                 "peak_bytes")
 
     def __init__(self, key):
         self.name = key.name
@@ -68,9 +69,10 @@ class ProgramRecord:
         self.serialized = False  # an entry for this digest was written
         self.arg_sig = None
         self.source = None       # "compile" | "cache" (last acquisition)
+        self.peak_bytes = None   # memory_analysis peak (telemetry.memory)
 
     def as_dict(self):
-        return {
+        out = {
             "name": self.name, "kind": self.kind,
             "digest": self.digest[:10],
             "compiles": self.compiles, "cache_hits": self.cache_hits,
@@ -81,6 +83,9 @@ class ProgramRecord:
             "serialized": self.serialized,
             "source": self.source,
         }
+        if self.peak_bytes is not None:
+            out["peak_bytes"] = self.peak_bytes
+        return out
 
 
 def get_record(key_or_digest):
@@ -132,6 +137,20 @@ def _emit_event(key, source, secs):
             _texp.emit_event("compile", name=key.name, kind=key.kind,
                              digest=key.digest[:10], source=source,
                              secs=round(secs, 4))
+    except Exception:
+        pass
+
+
+def _note_memory(key, rec, exe):
+    """Record the executable's ``memory_analysis()`` next to its cost
+    record (telemetry.memory) — read off the program already in hand,
+    never a second compile. Runs on BOTH acquisition paths (fresh
+    compile and AOT cache load), so a warm start still reports HBM."""
+    try:
+        from ..telemetry import memory as _tmem
+        stats = _tmem.record(key.name, key.kind, key.digest, exe)
+        if stats:
+            rec.peak_bytes = stats.get("peak_bytes")
     except Exception:
         pass
 
@@ -225,6 +244,7 @@ def load_or_compile(key, lower, cache=None):
             rec.cache_hits += 1
             rec.source = "cache"
             _count("compile.cache_hits")
+            _note_memory(key, rec, exe)
             _refresh_prof_counters()
             _emit_event(key, "cache", load_s)
             return exe, "cache"
@@ -263,6 +283,7 @@ def load_or_compile(key, lower, cache=None):
             logger.debug("compile-cache serialize skipped for %s: %s",
                          key.short, e)
         rec.serialize_s += time.perf_counter() - t0
+    _note_memory(key, rec, exe)
     _refresh_prof_counters()
     _emit_event(key, "compile", compile_s)
     return exe, "compile"

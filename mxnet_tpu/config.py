@@ -210,6 +210,21 @@ register("MXTPU_TELEMETRY_EVENT_STEPS", 50, int,
 register("MXTPU_TELEMETRY_SNAPSHOT_STEPS", 500, int,
          "Export a full telemetry snapshot every N train steps "
          "(plus one at timeline close); 0 = close-time snapshot only")
+register("MXTPU_TRACE_DIR", "", str,
+         "Structured-trace export directory (telemetry/trace.py): host "
+         "spans (serving request->batch->bucket, fit step->phase) land "
+         "in a bounded ring and export as Chrome trace-event JSON "
+         "(trace-<pid>-NNNNN.json, loadable in Perfetto / "
+         "chrome://tracing). Empty = tracing off (zero hot-path cost)")
+register("MXTPU_TRACE_RING", 16384, int,
+         "Span capacity of the in-memory trace ring: the newest N "
+         "completed spans are kept, older ones are overwritten "
+         "(trace::dropped counts them) — tracing never allocates "
+         "unboundedly on the hot path")
+register("MXTPU_TRACE_ANNOTATE", True, bool,
+         "Mirror trace spans as jax.profiler.TraceAnnotation while a "
+         "jax trace runs, so host spans and device timelines correlate "
+         "by name in the same profile")
 register("MXTPU_COMPILE_JAX_CACHE", True, bool,
          "Also point JAX's own persistent compilation cache at "
          "CACHE_DIR/xla (a second, backend-level layer on TPU/GPU; "

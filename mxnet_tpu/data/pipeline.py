@@ -216,6 +216,8 @@ class DataPipeline(DataIter):
         self._current = None
         self._slock = threading.Lock()
         self._zero_stats()
+        self._trace_id = None       # fit's trace (set_trace): stage
+        self._trace_parent = None   # spans link to the run-root span
         from .. import profiler
         self._dom = profiler.Domain("data")
         register_pipeline(self)
@@ -293,6 +295,24 @@ class DataPipeline(DataIter):
         with self._slock:
             setattr(self, field, getattr(self, field) + dt)
 
+    # -- structured tracing ----------------------------------------------------
+    def set_trace(self, trace_id, parent_id=None):
+        """Adopt the caller's trace (fit() hands its StepTimeline trace
+        id here): stage spans recorded on the pipeline's own threads
+        carry it, so Chrome-trace viewers show source/decode/stage work
+        in the same trace tree as the training steps it fed."""
+        self._trace_id = trace_id
+        self._trace_parent = parent_id
+
+    def _trace_stage(self, name, t0, dt, **args):
+        if self._trace_id is None:
+            return
+        from ..telemetry import trace as _trace
+        _trace.record_span(f"data:{name}", "data", t0, dt,
+                           trace_id=self._trace_id,
+                           parent_id=self._trace_parent,
+                           args=args or None)
+
     # -- stage threads ---------------------------------------------------------
     def _start_stream(self):
         if self._closed:
@@ -318,7 +338,9 @@ class DataPipeline(DataIter):
                     batch = self._base.next()
                 except StopIteration:
                     break
-            self._acc("_source_busy_s", time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self._acc("_source_busy_s", dt)
+            self._trace_stage("source", t0, dt, ordinal=ordinal)
             if skip > 0:       # checkpoint resume: replay to the cursor
                 skip -= 1
                 continue
@@ -350,12 +372,15 @@ class DataPipeline(DataIter):
             if self._transform is not None:
                 with self._dom.new_task("decode"):
                     batch = self._transform(batch)
+            dt = time.perf_counter() - t0
             n_items = self.batch_size or (
                 len(batch.data[0]) if batch.data else 0)
             with self._slock:
-                self._decode_busy_s += time.perf_counter() - t0
+                self._decode_busy_s += dt
                 self._batches_decoded += 1
                 self._items_decoded += n_items
+            self._trace_stage("decode", t0, dt, ordinal=ordinal,
+                              worker=widx)
             wk.q_put(self._q_done, (ordinal, batch), group)
 
     def _stager_loop(self, group):
@@ -398,9 +423,11 @@ class DataPipeline(DataIter):
                 staged.data = [self._put(a) for a in batch.data]
             if batch.label:
                 staged.label = [self._put(a) for a in batch.label]
+        dt = time.perf_counter() - t0
         with self._slock:
-            self._stage_busy_s += time.perf_counter() - t0
+            self._stage_busy_s += dt
             self._batches_staged += 1
+        self._trace_stage("stage", t0, dt)
         return staged
 
     def _put(self, arr):

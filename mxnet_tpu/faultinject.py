@@ -3,7 +3,7 @@
 Faults are armed **by site and ordinal**, never randomly: a spec names a
 site (``ckpt_write``, ``nan_grad``, ``data_iter``, ``data_worker``,
 ``dist_drop``, ``dist_init``, ``ckpt_truncate``, ``compile_cache``,
-``telemetry_write``, ``sparse_update``) plus
+``telemetry_write``, ``sparse_update``, ``slow_step``) plus
 the exact coordinate at which it fires (byte offset, step index, batch
 index, call ordinal). ``telemetry_write`` is consulted by the durable
 telemetry exporter (telemetry/export.py) on every event append
@@ -20,7 +20,11 @@ resumes from checkpoint. ``sparse_update`` fires in the fused step at
 the boundary where a row-sparse embedding update would commit
 (``step=N``); with ``action=kill`` it is the kill-mid-row-scatter drill
 proving checkpoint/resume restores sharded tables and lazy optimizer
-state bit-for-bit. The same spec
+state bit-for-bit. ``slow_step`` is consulted at the top of every fused
+train step; with ``action=sleep:ms=N`` it stretches each step by N
+milliseconds — the deterministic straggler-rank drill behind the fleet
+telemetry aggregator's skew flagging (arm it in ONE rank's environment
+and ``tools/telemetry.py fleet`` must name that rank). The same spec
 always produces the same failure, so CI chaos suites are reproducible
 bit-for-bit (contrast: the classic chaos-monkey coin flip, useless as a
 regression gate).
@@ -140,7 +144,7 @@ def _matches(params, ctx):
     """Every armed coordinate present in ``ctx`` must equal it; ``times``
     and ``action`` are modifiers, not coordinates."""
     for k, v in params.items():
-        if k in ("times", "action", "byte", "bytes", "match"):
+        if k in ("times", "action", "byte", "bytes", "match", "ms"):
             continue
         if k in ctx and ctx[k] != v:
             return False
@@ -172,8 +176,12 @@ def fire(site, **ctx):
         if "times" in params and _fired.get(site, 0) >= params["times"]:
             return False
         _record_fire(site)
-    if params.get("action") == "kill":
+    action = params.get("action")
+    if action == "kill":
         _sigkill(site)
+    elif action == "sleep":
+        import time
+        time.sleep(max(0, params.get("ms", 10)) / 1000.0)
     return True
 
 

@@ -298,6 +298,13 @@ class BaseModule:
         from ..telemetry import StepTimeline, export as _texp
         sym_name = getattr(self._symbol, "name", None) or "module"
         tl = StepTimeline(name=f"fit:{sym_name}").activate()
+        if tl.trace_id is not None:
+            # propagate the run's trace to the data pipeline: its
+            # source/decode/stage spans (recorded on pipeline threads)
+            # join this fit's trace tree in the Chrome-trace export
+            setter = getattr(train_data, "set_trace", None)
+            if callable(setter):
+                setter(tl.trace_id, tl.root_span_id)
         try:
             self.__fit_epochs(train_data, eval_data, eval_metric,
                               validation_metric, epoch_end_callback,

@@ -117,6 +117,8 @@ class FusedSymbolStep:
         self._step_jit = None
         self._programs = {}     # feed signature -> compiled executable
         self._program_costs = {}  # feed signature -> XLA cost dict
+        self._program_exes = {}   # feed signature -> raw executable
+        self._program_memory = {}  # feed signature -> memory_analysis dict
         self._noted_cost = None   # (timeline weakref, sig) last noted
         self._jit_options = None
         self._lr_cache = None
@@ -556,6 +558,8 @@ class FusedSymbolStep:
         # the old program's numbers
         self._programs = {}
         self._program_costs = {}
+        self._program_exes = {}
+        self._program_memory = {}
         self._noted_cost = None
 
     def staging_sharding(self):
@@ -654,6 +658,11 @@ class FusedSymbolStep:
         if self._step_jit is None:
             self._build()
         from .. import faultinject
+        # deterministic straggler drill: 'slow_step:action=sleep:ms=N'
+        # stretches every step by N ms — armed in ONE rank's environment
+        # it is the injected skew the fleet telemetry aggregator
+        # (tools/telemetry.py fleet) must flag
+        faultinject.fire("slow_step", step=self.num_update)
         if self._sparse_sites:
             # the kill-mid-row-scatter drill: with action=kill the
             # process dies at the step boundary where the row update
@@ -801,6 +810,7 @@ class FusedSymbolStep:
         gauges; the active StepTimeline derives roofline-fraction from
         the same numbers. Best-effort: some backends/AOT-loaded
         executables don't expose cost analysis."""
+        self._program_exes[sig] = exe
         try:
             cost = exe.cost_analysis()
             if isinstance(cost, (list, tuple)):
@@ -809,6 +819,11 @@ class FusedSymbolStep:
         except Exception:
             cost = {}
         self._program_costs[sig] = cost
+        try:
+            from ..telemetry import memory as _tmem
+            self._program_memory[sig] = _tmem.analyze(exe)
+        except Exception:
+            self._program_memory[sig] = {}
         if not cost:
             return
         try:
@@ -861,6 +876,10 @@ class FusedSymbolStep:
                                     self._t_dev, self._lr_cache[1],
                                     self._base_key)
 
+    def _feed_sig(self, feed):
+        return tuple((tuple(feed[n].shape), str(feed[n].dtype))
+                     for n in self.input_names)
+
     def step_cost(self, feed):
         """XLA cost analysis of the compiled step as a plain dict
         (keys like "flops", "bytes accessed"; {} when unavailable).
@@ -869,15 +888,32 @@ class FusedSymbolStep:
         A/B tests all read costs through here. A program already
         acquired by :meth:`step` answers from the recorded cost
         (``_note_cost``) instead of paying a second lower+compile."""
-        sig = tuple((tuple(feed[n].shape), str(feed[n].dtype))
-                    for n in self.input_names)
-        cached = self._program_costs.get(sig)
+        cached = self._program_costs.get(self._feed_sig(feed))
         if cached:
             return dict(cached)
         cost = self.lowered(feed).compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}
         return dict(cost) if cost else {}
+
+    def step_memory(self, feed):
+        """``memory_analysis()`` of the compiled step as a plain dict
+        (argument/output/temp/alias bytes + derived peak; {} when the
+        backend has none) — same ``_note_cost`` rule as :meth:`step_cost`:
+        a program already acquired answers from its record, never a
+        second lower+compile."""
+        cached = self._program_memory.get(self._feed_sig(feed))
+        if cached:
+            return dict(cached)
+        from ..telemetry import memory as _tmem
+        return _tmem.analyze(self.lowered(feed).compile())
+
+    def compiled_program(self, feed):
+        """The ALREADY-acquired executable for this feed signature, or
+        None before :meth:`step` ran it. Tools (hlo_breakdown /
+        step_profile) read HLO text and analyses off this instead of
+        paying a second lower+compile."""
+        return self._program_exes.get(self._feed_sig(feed))
 
     def load_params(self, arg_dict, aux_dict):
         """Refresh parameter/aux buffers from executor arrays (set_params

@@ -31,7 +31,7 @@ from ..kvstore import KVStore
 from ..ndarray.ndarray import NDArray, _wrap
 
 __all__ = ["DistKVStore", "init", "barrier", "allreduce", "rank",
-           "world_size"]
+           "world_size", "process_identity"]
 
 _initialized = [False]
 _host_fallback = [False]    # sticky: backend lacks multiproc collectives
@@ -120,6 +120,14 @@ def world_size():
         return max(1, int(jax.process_count()))
     except Exception:
         return 1
+
+
+def process_identity():
+    """``(rank, world_size)`` in one call — the selector behind the
+    telemetry exporter's ``rank-<r>/`` directory layout (telemetry/
+    export.py): multi-process runs split their event logs, snapshots
+    and traces per rank; single-process runs stay flat."""
+    return rank(), world_size()
 
 
 def _kv_client():

@@ -38,6 +38,7 @@ import numpy as np
 from .. import config
 from .. import profiler
 from ..base import MXNetError
+from ..telemetry import trace as _trace
 from . import DeadlineExceeded, Overloaded, _register_batcher
 
 __all__ = ["DynamicBatcher", "ServingFuture"]
@@ -47,14 +48,17 @@ _DEADLINE_SLACK_S = 0.002  # launch this early so an at-deadline
 
 
 class ServingFuture:
-    """Completion handle for one submitted request."""
+    """Completion handle for one submitted request. ``trace_id`` is the
+    request's id in the structured-trace/event-log surfaces — a client
+    can log it and correlate its own latency with the server's spans."""
 
-    __slots__ = ("_event", "_result", "_error")
+    __slots__ = ("_event", "_result", "_error", "trace_id")
 
     def __init__(self):
         self._event = threading.Event()
         self._result = None
         self._error = None
+        self.trace_id = None
 
     def _complete(self, result=None, error=None):
         self._result = result
@@ -73,13 +77,19 @@ class ServingFuture:
 
 
 class _Request:
-    __slots__ = ("arrays", "rows", "future", "deadline", "t_submit")
+    __slots__ = ("arrays", "rows", "future", "deadline", "t_submit",
+                 "trace_id", "span_id")
 
     def __init__(self, arrays, rows, future, deadline):
         self.arrays = arrays
         self.rows = rows
         self.future = future
         self.deadline = deadline
+        # every request gets a trace id (a counter-based f-string — no
+        # syscall): shed/expired/served, the event log and the trace
+        # export attribute it to THIS request, not an anonymous counter
+        self.trace_id = future.trace_id = _trace.new_trace_id()
+        self.span_id = _trace.new_span_id()
         self.t_submit = time.perf_counter()
 
 
@@ -190,6 +200,10 @@ class DynamicBatcher:
                 "stop() again to re-join, or stop(drain=False) next "
                 "time to shed instead")
         self._thread = None
+        if _trace.enabled():
+            # flush the serving spans now that the loop is quiet —
+            # export never sits on a request path
+            _trace.export_trace()
 
     def __enter__(self):
         return self.start()
@@ -221,14 +235,37 @@ class DynamicBatcher:
                     f"DynamicBatcher '{self.name}' is not started")
             if self._queued_rows + rows > self.max_queue:
                 self._shed += 1
-                raise Overloaded(
-                    f"serving queue at bound ({self._queued_rows} rows "
-                    f"queued, max_queue={self.max_queue}); shedding "
-                    "load — retry with backoff")
-            self._queue.append(req)
-            self._queued_rows += rows
-            self._cond.notify_all()
+                shed_depth = self._queued_rows
+            else:
+                shed_depth = None
+                self._queue.append(req)
+                self._queued_rows += rows
+                self._cond.notify_all()
+        if shed_depth is not None:
+            # attributable shed: the event (and trace span) carry the
+            # request's trace id — emitted OUTSIDE the queue lock, on
+            # the already-failing path only
+            self._shed_event(req, shed_depth)
+            raise Overloaded(
+                f"serving queue at bound ({shed_depth} rows "
+                f"queued, max_queue={self.max_queue}); shedding "
+                "load — retry with backoff")
         return future
+
+    def _shed_event(self, req, queue_rows):
+        from ..telemetry import export as _texp
+        if _texp.enabled():
+            _texp.emit_event(
+                "serving_overloaded", batcher=self.telemetry_id,
+                predictor=self.predictor.telemetry_id,
+                trace_id=req.trace_id, rows=req.rows,
+                queue_rows=queue_rows, max_queue=self.max_queue)
+        if _trace.enabled():
+            _trace.record_span(
+                "serving:request", "serving", req.t_submit,
+                time.perf_counter() - req.t_submit,
+                trace_id=req.trace_id, span_id=req.span_id,
+                args={"rows": req.rows, "error": "Overloaded"})
 
     def predict(self, data, deadline_ms=None, timeout=None):
         """Blocking convenience: ``submit(...).result(...)``."""
@@ -268,7 +305,7 @@ class DynamicBatcher:
                 if rows >= self.max_batch or remaining <= 0:
                     break
                 self._cond.wait(timeout=remaining)
-            batch, rows = [], 0
+            batch, rows, expired = [], 0, []
             now = time.perf_counter()
             while self._queue:
                 r = self._queue[0]
@@ -278,9 +315,11 @@ class DynamicBatcher:
                     self._queue.pop(0)
                     self._queued_rows -= r.rows
                     self._deadline_missed += 1
+                    waited_ms = (now - r.t_submit) * 1e3
                     r.future._complete(error=DeadlineExceeded(
                         f"deadline expired after "
-                        f"{(now - r.t_submit) * 1e3:.1f} ms in queue"))
+                        f"{waited_ms:.1f} ms in queue"))
+                    expired.append((r, waited_ms))
                     continue
                 if rows + r.rows > self.max_batch:
                     break
@@ -288,7 +327,23 @@ class DynamicBatcher:
                 self._queued_rows -= r.rows
                 batch.append(r)
                 rows += r.rows
-            return batch
+        # expired-request events/spans land OUTSIDE the queue lock —
+        # after the futures completed, like the serving_batch event
+        from ..telemetry import export as _texp
+        for r, waited_ms in expired:
+            if _texp.enabled():
+                _texp.emit_event(
+                    "serving_deadline", batcher=self.telemetry_id,
+                    predictor=self.predictor.telemetry_id,
+                    trace_id=r.trace_id, rows=r.rows,
+                    waited_ms=round(waited_ms, 3))
+            if _trace.enabled():
+                _trace.record_span(
+                    "serving:request", "serving", r.t_submit,
+                    waited_ms / 1e3, trace_id=r.trace_id,
+                    span_id=r.span_id,
+                    args={"rows": r.rows, "error": "DeadlineExceeded"})
+        return batch
 
     def _loop(self):
         while True:
@@ -304,7 +359,19 @@ class DynamicBatcher:
                 if len(batch) > 1 else batch[0].arrays[i]
                 for i in range(len(self.predictor.data_names))]
             try:
-                with self._tasks[bucket]:
+                # the batch span adopts the FIRST member request's trace
+                # and lists every member's trace id in its args — the
+                # bucket span the Predictor opens inside nests under it
+                # (TLS parent linkage), so a Chrome-trace viewer shows
+                # request -> batch -> bucket as one tree
+                with _trace.span(
+                        "serving:batch", cat="serving",
+                        trace=batch[0].trace_id,
+                        args={"batcher": self.telemetry_id,
+                              "bucket": bucket, "rows": rows,
+                              "requests": len(batch),
+                              "trace_ids": [r.trace_id for r in batch]}
+                ) as bspan, self._tasks[bucket]:
                     outs = self.predictor._run_bucket(arrays, rows,
                                                       bucket)
             except Exception as e:               # noqa: BLE001
@@ -335,15 +402,24 @@ class DynamicBatcher:
                 r.future._complete(
                     result=mine[0] if len(mine) == 1 else mine)
                 start += r.rows
-            # durable event AFTER the futures complete: the exporter's
-            # locked disk append must never sit on the client-visible
-            # response path
+            # durable event + request spans AFTER the futures complete:
+            # the exporter's locked disk append must never sit on the
+            # client-visible response path
+            if _trace.enabled():
+                for r in batch:
+                    _trace.record_span(
+                        "serving:request", "serving", r.t_submit,
+                        now - r.t_submit, trace_id=r.trace_id,
+                        span_id=r.span_id,
+                        args={"rows": r.rows,
+                              "batch_span": bspan.span_id})
             from ..telemetry import export as _texp
             if _texp.enabled():
                 _texp.emit_event(
                     "serving_batch", batcher=self.telemetry_id,
                     predictor=self.predictor.telemetry_id,
                     bucket=bucket, rows=rows, requests=len(batch),
+                    trace_ids=[r.trace_id for r in batch],
                     max_latency_ms=round(max(
                         (now - r.t_submit) * 1e3 for r in batch), 3))
 

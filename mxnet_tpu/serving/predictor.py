@@ -264,6 +264,8 @@ class Predictor:
         self._donate = bool(donate)
         self._programs = {}     # (bucket, dtypes) -> compiled program
         self._program_costs = {}  # (bucket, dtypes) -> XLA cost dict
+        self._program_exes = {}   # (bucket, dtypes) -> raw executable
+        self._program_memory = {}  # (bucket, dtypes) -> memory dict
         self._materialized = 0  # fresh traces taken BY this instance
         self._cache_loads = 0   # bucket programs AOT-loaded from disk
         self._lock = threading.Lock()
@@ -366,8 +368,10 @@ class Predictor:
     def _note_cost(self, bucket, dtypes, exe):
         """Record XLA cost analysis of an acquired bucket program
         (bytes accessed is the serving-program currency too: the BN
-        constant-fold exists to shrink it). Best-effort — some
-        backends/AOT loads expose none."""
+        constant-fold exists to shrink it), and of its memory analysis
+        (telemetry.memory — per-bucket HBM next to the cost record).
+        Best-effort — some backends/AOT loads expose none."""
+        self._program_exes[(bucket, dtypes)] = exe
         try:
             cost = exe.cost_analysis()
             if isinstance(cost, (list, tuple)):
@@ -376,6 +380,11 @@ class Predictor:
                 if cost else {}
         except Exception:
             self._program_costs[(bucket, dtypes)] = {}
+        try:
+            from ..telemetry import memory as _tmem
+            self._program_memory[(bucket, dtypes)] = _tmem.analyze(exe)
+        except Exception:
+            self._program_memory[(bucket, dtypes)] = {}
 
     def program_cost(self, bucket=None):
         """XLA cost dict of one bucket's compiled program (largest
@@ -386,6 +395,17 @@ class Predictor:
         for (bk, _dt), cost in self._program_costs.items():
             if bk == b and cost:
                 return dict(cost)
+        return {}
+
+    def program_memory(self, bucket=None):
+        """``memory_analysis()`` dict of one bucket's compiled program
+        (largest bucket by default; {} when not yet materialized or the
+        backend exposes none) — recorded at acquisition, same rule as
+        :meth:`program_cost`: never a second compile."""
+        b = self.buckets[-1] if bucket is None else bucket
+        for (bk, _dt), mem in self._program_memory.items():
+            if bk == b and mem:
+                return dict(mem)
         return {}
 
     # -- execution ------------------------------------------------------------
@@ -399,7 +419,11 @@ class Predictor:
                 pad = np.zeros((bucket - rows,) + a.shape[1:], a.dtype)
                 a = np.concatenate([a, pad], axis=0)
             padded.append(jnp.asarray(a))
-        with self._lock:
+        from ..telemetry import trace as _trace
+        with self._lock, _trace.span(
+                f"serving:bucket{bucket}", cat="serving",
+                args={"predictor": self.telemetry_id, "rows": rows,
+                      "pad_rows": bucket - rows}):
             args = (self._pvals_t, tuple(padded), self._avals,
                     self._hvals)
             pkey = (bucket, tuple(str(a.dtype) for a in padded))

@@ -22,6 +22,13 @@ The observability layer everything reports into (``mx.telemetry``):
   Prometheus-style text rendering. ``tools/telemetry.py`` tails,
   summarizes, and diffs the exports; ``diff --gate-bytes`` is the
   reusable bytes-accessed regression gate.
+- **trace.py** (round 14) — structured host tracing: spans with
+  trace/span ids in a bounded ring, propagated serving request ->
+  batch -> bucket and fit step -> pipeline stage -> step phase,
+  exported as Chrome trace-event JSON under ``MXTPU_TRACE_DIR``.
+- **memory.py** (round 14) — per-program HBM accounting read off every
+  compiled executable's ``memory_analysis()``: ``mx.memory_report()``,
+  ``mem::`` gauges, and the ``--gate-peak-mem`` CI gate's input.
 
 Everything here is observability: failures count and log, they never
 take down the training step or the serving loop.
@@ -31,6 +38,8 @@ from __future__ import annotations
 from . import registry
 from . import timeline
 from . import export
+from . import trace
+from . import memory
 from .registry import (Counter, Gauge, Timer, Histogram, counter, gauge,
                        timer, histogram, snapshot, report, collect,
                        register_collector, reset, remove)
@@ -38,12 +47,13 @@ from .timeline import (StepTimeline, current, peak_hbm_bytes_s,
                        set_step_cost)
 from .export import (enabled, telemetry_dir, emit_event, export_snapshot,
                      render_prometheus, read_events)
+from .memory import memory_report
 
-__all__ = ["registry", "timeline", "export",
+__all__ = ["registry", "timeline", "export", "trace", "memory",
            "Counter", "Gauge", "Timer", "Histogram",
            "counter", "gauge", "timer", "histogram",
            "snapshot", "report", "collect", "register_collector", "reset",
            "remove",
            "StepTimeline", "current", "peak_hbm_bytes_s", "set_step_cost",
            "enabled", "telemetry_dir", "emit_event", "export_snapshot",
-           "render_prometheus", "read_events"]
+           "render_prometheus", "read_events", "memory_report"]

@@ -22,6 +22,14 @@ set, this module maintains:
 - :func:`render_prometheus` — the registry in Prometheus text
   exposition format, for a scrape endpoint or node textfile collector.
 
+**Fleet layout (round 14):** in a multi-process run every exporter
+writes under ``MXTPU_TELEMETRY_DIR/rank-<r>/`` (r = process index from
+parallel/dist), so N ranks pointed at one shared directory never
+interleave their logs; ``tools/telemetry.py fleet`` merges the rank
+subdirectories into fleet percentiles and per-rank step-time skew.
+Single-process runs keep the flat layout — every r11 path and tool
+works unchanged.
+
 The ``telemetry_write`` fault-injection site (faultinject.py) is
 consulted on every event write (``event=N`` ordinal) and every rotation
 (``rotation=K``): ``action=kill`` SIGKILLs mid-write/mid-rotation — the
@@ -41,9 +49,9 @@ import time
 
 from . import registry
 
-__all__ = ["enabled", "telemetry_dir", "emit_event", "export_snapshot",
-           "render_prometheus", "event_files", "snapshot_files",
-           "read_events", "reset_exporter"]
+__all__ = ["enabled", "telemetry_dir", "rank_subdir", "emit_event",
+           "export_snapshot", "render_prometheus", "event_files",
+           "snapshot_files", "read_events", "reset_exporter"]
 
 _lock = threading.Lock()
 _log = None          # the singleton _EventLog (created on first emit)
@@ -51,13 +59,32 @@ _log = None          # the singleton _EventLog (created on first emit)
 _EVENT_RE = re.compile(r"events-(\d+)\.jsonl$")
 
 
+def rank_subdir(base):
+    """``base/rank-<r>`` in a multi-process run, ``base`` otherwise —
+    the one rule behind the fleet directory layout (trace export uses
+    it too, so traces and events from rank r land side by side)."""
+    if not base:
+        return base
+    try:
+        from ..parallel import dist
+        r, w = dist.process_identity()
+    except Exception:
+        return base
+    if w > 1:
+        return os.path.join(base, f"rank-{r}")
+    return base
+
+
 def telemetry_dir():
+    """The effective export directory for THIS process: the configured
+    ``MXTPU_TELEMETRY_DIR``, rank-qualified in multi-process runs."""
     from .. import config
-    return str(config.get("MXTPU_TELEMETRY_DIR") or "")
+    return rank_subdir(str(config.get("MXTPU_TELEMETRY_DIR") or ""))
 
 
 def enabled():
-    return bool(telemetry_dir())
+    from .. import config
+    return bool(str(config.get("MXTPU_TELEMETRY_DIR") or ""))
 
 
 def event_files(directory=None):

@@ -1,0 +1,158 @@
+"""Per-program device-memory accounting read off compiled executables.
+
+Every executable the compile registry hands out (fused train steps,
+Predictor buckets — compile/registry.py ``load_or_compile``) already
+carries XLA's buffer-assignment answer: ``compiled.memory_analysis()``
+reports argument/output/temp/alias bytes for the program. r11 recorded
+the cost-analysis side (flops, bytes accessed) and threw the memory
+side away; this module keeps it, next to the same program identity
+(name/kind/digest), and exposes:
+
+- ``mx.memory_report()`` — per-program rows (peak, temp, argument,
+  output, alias/donation bytes) plus the process view (program count,
+  max peak, total donation saving),
+- ``mem::`` gauges (``mem::process_peak_bytes``,
+  ``mem::donation_saved_bytes``, ``mem::programs``, and per-program
+  ``mem::<name>::peak_bytes``) in the flat registry, so snapshots and
+  the Prometheus rendering carry HBM levels without a separate path,
+- the baseline the roadmap-item-1 ZeRO-1 work is judged against:
+  ``tools/telemetry.py diff --gate-peak-mem`` fails CI when a program's
+  recorded peak regresses.
+
+``peak_bytes`` uses XLA's own peak when the jaxlib exposes one;
+otherwise it is derived as ``argument + output + temp - alias`` — alias
+bytes are exactly the donated-input saving (a donated buffer is counted
+once, not as argument AND output). Recording follows the r11
+``_note_cost`` rule: always read off the executable already in hand,
+never a second lower+compile; a backend whose executables lack
+``memory_analysis`` records nothing and costs nothing.
+"""
+from __future__ import annotations
+
+import threading
+
+from . import registry
+
+__all__ = ["analyze", "record", "programs", "process_peak",
+           "memory_report", "reset"]
+
+_lock = threading.Lock()
+_programs = {}       # digest -> {name, kind, digest, ...bytes}
+
+_FIELDS = (("argument_size_in_bytes", "argument_bytes"),
+           ("output_size_in_bytes", "output_bytes"),
+           ("temp_size_in_bytes", "temp_bytes"),
+           ("alias_size_in_bytes", "alias_bytes"),
+           ("generated_code_size_in_bytes", "generated_code_bytes"))
+
+
+def analyze(exe):
+    """``memory_analysis()`` of one executable as a plain dict (with
+    derived ``peak_bytes`` and ``donation_saved_bytes``), or ``{}`` when
+    the backend doesn't expose it. Pure read — no compile, no sync."""
+    try:
+        ma = exe.memory_analysis()
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+    out = {}
+    for attr, name in _FIELDS:
+        try:
+            v = int(getattr(ma, attr))
+        except (AttributeError, TypeError, ValueError):
+            continue
+        if v >= 0:
+            out[name] = v
+    if not out:
+        return {}
+    peak = 0
+    for attr in ("peak_memory_in_bytes", "peak_size_in_bytes"):
+        try:
+            peak = int(getattr(ma, attr))
+            break
+        except (AttributeError, TypeError, ValueError):
+            continue
+    if peak <= 0:
+        # buffer-assignment identity: donated (aliased) input bytes are
+        # reused for outputs, so they count once
+        peak = (out.get("argument_bytes", 0) + out.get("output_bytes", 0)
+                + out.get("temp_bytes", 0) - out.get("alias_bytes", 0))
+    out["peak_bytes"] = max(0, int(peak))
+    out["donation_saved_bytes"] = out.get("alias_bytes", 0)
+    return out
+
+
+def record(name, kind, digest, exe_or_stats):
+    """Record one program's memory analysis (keyed by HLO digest, so a
+    recompile of the same program overwrites rather than duplicates).
+    Returns the stats dict (``{}`` when the backend has none)."""
+    stats = (dict(exe_or_stats) if isinstance(exe_or_stats, dict)
+             else analyze(exe_or_stats))
+    if not stats:
+        return {}
+    row = {"name": str(name), "kind": str(kind),
+           "digest": str(digest)[:12], **stats}
+    with _lock:
+        _programs[str(digest)] = row
+        progs = list(_programs.values())
+    _refresh_gauges(progs)
+    return stats
+
+
+def _refresh_gauges(progs):
+    try:
+        registry.gauge("mem::programs").set(len(progs))
+        registry.gauge("mem::process_peak_bytes").set(
+            max((p["peak_bytes"] for p in progs), default=0))
+        registry.gauge("mem::donation_saved_bytes").set(
+            sum(p.get("donation_saved_bytes", 0) for p in progs))
+        for p in progs:
+            registry.gauge(
+                f"mem::{p['name']}::peak_bytes").set(p["peak_bytes"])
+    except Exception:
+        pass
+
+
+def programs():
+    """Recorded per-program rows, largest peak first."""
+    with _lock:
+        rows = [dict(p) for p in _programs.values()]
+    rows.sort(key=lambda p: (-p.get("peak_bytes", 0), p["name"]))
+    return rows
+
+
+def process_peak():
+    """max over recorded programs' ``peak_bytes`` (0 when none) — the
+    process-HBM headline number and the ``--gate-peak-mem`` input."""
+    with _lock:
+        return max((p.get("peak_bytes", 0)
+                    for p in _programs.values()), default=0)
+
+
+def _collect(reset=False):
+    rows = programs()
+    tree = {
+        "programs": rows,
+        "process": {
+            "programs": len(rows),
+            "peak_bytes": max((p.get("peak_bytes", 0) for p in rows),
+                              default=0),
+            "donation_saved_bytes": sum(
+                p.get("donation_saved_bytes", 0) for p in rows),
+            "temp_bytes": sum(p.get("temp_bytes", 0) for p in rows),
+        },
+    }
+    if reset:
+        with _lock:
+            _programs.clear()
+        registry.remove("mem::")
+    return tree
+
+
+memory_report = registry.collector_view("memory", _collect)
+
+
+def reset():
+    """Drop every recorded program and the ``mem::`` gauges (tests)."""
+    _collect(reset=True)

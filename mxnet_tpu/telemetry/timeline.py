@@ -36,6 +36,7 @@ import threading
 import time
 
 from . import registry
+from . import trace as _trace
 
 __all__ = ["StepTimeline", "current", "null_phase", "peak_hbm_bytes_s",
            "set_step_cost", "PHASES"]
@@ -196,6 +197,17 @@ class StepTimeline:
         self._snapshot_every = int(
             config.get("MXTPU_TELEMETRY_SNAPSHOT_STEPS"))
         self._snap_thread = None
+        # structured tracing (telemetry/trace.py): the timeline IS the
+        # phase measurement, so trace spans are recorded FROM the
+        # _enter/_exit bookkeeping below — same perf_counter reads,
+        # never a second clock. All of it is off unless MXTPU_TRACE_DIR
+        # is set (checked once per step, not per phase).
+        self._trace_on = False
+        self._trace_id = None    # one trace per run (fit/epoch loop)
+        self._root_span = None   # the run-root span id ("fit:<name>")
+        self._step_span = None   # current step's span id
+        self._t_activate = None
+        self._t_step0 = None
 
     # -- lifecycle ------------------------------------------------------------
     def activate(self):
@@ -204,7 +216,22 @@ class StepTimeline:
         global _current, _current_tid
         _current = self
         _current_tid = threading.get_ident()
+        self._t_activate = time.perf_counter()
+        self._trace_on = _trace.enabled()
+        if self._trace_on and self._trace_id is None:
+            self._trace_id = _trace.new_trace_id()
+            self._root_span = _trace.new_span_id()
         return self
+
+    @property
+    def trace_id(self):
+        """This run's trace id (None unless tracing) — what fit() hands
+        the data pipeline so stage spans link to the run root."""
+        return self._trace_id
+
+    @property
+    def root_span_id(self):
+        return self._root_span
 
     def close(self):
         """Deactivate; flush a final snapshot + event when exporting."""
@@ -212,6 +239,15 @@ class StepTimeline:
         if _current is self:
             _current = None
             _current_tid = None
+        if self._trace_id is not None and self._t_activate is not None:
+            _trace.record_span(
+                self.name, "train", self._t_activate,
+                time.perf_counter() - self._t_activate,
+                trace_id=self._trace_id, span_id=self._root_span,
+                args={"steps": self.steps})
+            self._t_activate = None
+        if _trace.enabled():
+            _trace.export_trace()
         from . import export
         if export.enabled():
             export.emit_event("timeline_close", name=self.name,
@@ -228,16 +264,24 @@ class StepTimeline:
         return p
 
     def _enter(self, name):
-        self._stack.append([name, time.perf_counter(), 0.0])
+        sid = _trace.new_span_id() if self._trace_on else None
+        self._stack.append([name, time.perf_counter(), 0.0, sid])
 
     def _exit(self):
         if not self._stack:      # defensive: never raise out of a step
             return
-        name, t0, child = self._stack.pop()
+        name, t0, child, sid = self._stack.pop()
         dur = time.perf_counter() - t0
         self._acc[name] = self._acc.get(name, 0.0) + max(0.0, dur - child)
         if self._stack:
             self._stack[-1][2] += dur
+        if sid is not None:
+            # the phase record IS the trace span — same t0/dur, one
+            # ring append, no I/O
+            parent = self._stack[-1][3] if self._stack else self._step_span
+            _trace.record_span(name, "step", t0, dur,
+                               trace_id=self._trace_id, span_id=sid,
+                               parent_id=parent or self._root_span)
 
     # -- steps ----------------------------------------------------------------
     def step_start(self):
@@ -248,7 +292,15 @@ class StepTimeline:
         the loop's per-batch step_start then must not reset it."""
         if self._t_step is not None:
             return
-        self._t_step = time.perf_counter()
+        self._trace_on = _trace.enabled()
+        if self._trace_on:
+            if self._trace_id is None:
+                self._trace_id = _trace.new_trace_id()
+                self._root_span = _trace.new_span_id()
+            self._step_span = _trace.new_span_id()
+        else:
+            self._step_span = None
+        self._t_step = self._t_step0 = time.perf_counter()
         self._acc = {}
         self._stack = []
 
@@ -274,6 +326,13 @@ class StepTimeline:
             return None
         wall = time.perf_counter() - self._t_step
         self._t_step = None
+        if self._step_span is not None:
+            _trace.record_span("step", "step", self._t_step0, wall,
+                               trace_id=self._trace_id,
+                               span_id=self._step_span,
+                               parent_id=self._root_span,
+                               args={"step": self.steps + 1})
+            self._step_span = None
         self.steps += 1
         self._steps_c.inc()
         self._wall_h.observe(wall)

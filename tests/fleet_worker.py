@@ -1,0 +1,70 @@
+"""Worker process for the multi-rank fleet-telemetry straggler test.
+
+Launched N times locally by test_trace_memory.py (same shape as
+dist_worker.py): each process is one jax.distributed participant with a
+single CPU device, runs a tiny fused fit() with per-step ``train_step``
+event export, and exits. The parent arms ONE rank with the
+deterministic ``slow_step`` sleep drill via ``MXTPU_FAULT_INJECT``;
+afterwards ``tools/telemetry.py fleet`` over the shared base dir must
+flag exactly that rank as the straggler.
+
+The telemetry exporter rank-qualifies its directory itself
+(``export.rank_subdir``), so every rank gets the SAME
+``MXTPU_TELEMETRY_DIR`` and the ``rank-<r>/`` fan-out under it is the
+behavior under test, not test scaffolding.
+
+Usage: fleet_worker.py <coordinator> <num_procs> <rank> <ok_dir>
+"""
+import os
+import sys
+
+coordinator, n_procs, rank, ok_dir = sys.argv[1:5]
+n_procs, rank = int(n_procs), int(rank)
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ.pop("JAX_PLATFORMS", None)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=coordinator,
+                           num_processes=n_procs, process_id=rank)
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import dist
+
+r, w = dist.process_identity()
+assert (r, w) == (rank, n_procs), (r, w)
+
+mx.random.seed(0)
+np.random.seed(rank)
+
+x = np.random.rand(64, 8).astype(np.float32)
+y = (x.sum(1) * 2).astype(np.int32).astype(np.float32) % 4
+it = mx.io.NDArrayIter(x, y, batch_size=16)
+net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                            name="fc")
+net = mx.sym.SoftmaxOutput(net, name="softmax")
+# mx.cpu(i) indexes the GLOBAL device list; each process must train on
+# its own (only addressable) device — one device per rank here
+mod = mx.mod.Module(context=mx.cpu(rank), symbol=net, fused=True)
+mod.fit(it, num_epoch=3, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1},
+        initializer=mx.init.Xavier())
+
+# the exporter must have fanned out into this rank's own subdir
+from mxnet_tpu.telemetry import export
+d = export.telemetry_dir()
+assert d.endswith(f"rank-{rank}"), d
+events, _ = export.read_events(d)
+assert any(e.get("kind") == "train_step" for e in events), \
+    f"rank {rank}: no train_step events under {d}"
+
+dist.barrier()
+
+with open(os.path.join(ok_dir, f"ok_{rank}"), "w") as f:
+    f.write("ok")
+print(f"rank {rank}: fleet telemetry written to {d}")

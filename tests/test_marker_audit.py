@@ -224,3 +224,33 @@ def test_sparse_embedding_suite_stays_tier1_with_chaos_marked():
     assert "test_sparse_embedding.py" in uses.get("chaos", set()), (
         "the SIGKILL-mid-sparse-update resume drill must carry "
         "pytest.mark.chaos like the other fault-injection suites")
+
+
+def test_trace_memory_suite_stays_tier1_with_chaos_marked():
+    """The trace/memory suite is tier-1's only proof that exported
+    Chrome traces keep correct request→batch→bucket and step→phase
+    nesting, that ``mx.memory_report()`` agrees with XLA's
+    ``memory_analysis()`` for the fused step and every Predictor
+    bucket, and that tracing overhead stays within its 2% budget. It
+    must (a) exist, (b) never carry a module-wide or per-case ``slow``
+    mark that would drop those pins from the gate, and (c) mark its
+    multi-process fleet straggler drill ``chaos`` so ``-m chaos``
+    selects the whole fault surface."""
+    path = os.path.join(_TESTS, "test_trace_memory.py")
+    assert os.path.exists(path), "tests/test_trace_memory.py missing"
+    with open(path) as f:
+        src = f.read()
+    m = re.search(r"^pytestmark\s*=.*$", src, re.M)
+    assert m is None or "slow" not in m.group(0), (
+        "test_trace_memory.py must stay tier-1: a module-level slow "
+        "mark drops the trace-nesting and memory-report pins from "
+        "the gate")
+    uses = _mark_uses()
+    assert "test_trace_memory.py" not in uses.get("slow", set()), (
+        "test_trace_memory.py cases must not be slow-marked — the "
+        "trace schema, memory_report parity, and overhead budget are "
+        "round-14 acceptance pins")
+    assert "test_trace_memory.py" in uses.get("chaos", set()), (
+        "the 4-process fleet straggler drill (slow_step faultinject) "
+        "must carry pytest.mark.chaos like the other fault-injection "
+        "suites")

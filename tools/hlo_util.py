@@ -1,0 +1,228 @@
+"""Shared HLO-text walking helpers for the offline analysis tools.
+
+``hlo_breakdown.py`` (static FLOP attribution) and ``step_profile.py``
+(measured time attribution) both parse optimized-HLO dumps: symbol
+tables from definition lines, analytic conv/dot FLOP counts, and
+instruction -> category maps built from fusion bodies. Round 14
+deduplicates those parsers here so the two tools cannot drift apart —
+one regex set, one dimension-numbers convention.
+
+Also home to ``compiled_step()``: the tools used to lower+compile the
+fused step a SECOND time just to read its HLO/cost, which doubled their
+wall time and could diverge from the program the model actually ran.
+The compile registry (r11) and the fused module now retain the
+executable they benched, so the tools answer from that recorded
+analysis instead.
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = [
+    "DEF_RE", "build_symtab", "conv_flops", "dot_flops",
+    "parse_kind", "categorize_hlo", "fallback_cat",
+    "conv_descriptions", "compiled_step",
+]
+
+# '%name = dtype[d0,d1,...]' definition lines of an optimized HLO dump
+DEF_RE = re.compile(r"^\s*(?:ROOT\s+)?(%[\w.\-]+)\s*=\s*(\w+)\[([\d,]*)\]")
+
+# operand lists print either bare ('conv(%a, %b)') or typed
+# ('conv(f32[8,3]{1,0} %a, ...)') depending on the executable's printer
+_CONV_OPS_RE = re.compile(
+    r"convolution\((?:\S+\s+)?(%[\w.\-]+),\s*(?:\S+\s+)?(%[\w.\-]+)\)")
+_DOT_OPS_RE = re.compile(
+    r"\bdot\((?:\S+\s+)?(%[\w.\-]+),\s*(?:\S+\s+)?(%[\w.\-]+)\)")
+
+_KIND_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?(%[\w.\-]+)\s*=\s*(?:\([^)]*\)|\S+)\s+([\w\-]+)\(")
+
+
+def build_symtab(hlo):
+    """instruction name -> (dtype, [dims]) from every definition line."""
+    tab = {}
+    for line in hlo.splitlines():
+        m = DEF_RE.match(line)
+        if m:
+            dims = [int(x) for x in m.group(3).split(",")] \
+                if m.group(3) else []
+            tab[m.group(1)] = (m.group(2), dims)
+    return tab
+
+
+def conv_flops(line, tab):
+    """Analytic FLOPs of one HLO convolution line (2*MACs)."""
+    m = DEF_RE.match(line)
+    dn = re.search(r"dim_labels=([\w>\-]+)", line)
+    ops = _CONV_OPS_RE.search(line)
+    if not (m and dn and ops):
+        return None
+    out_dt = m.group(2)
+    out_dims = [int(x) for x in m.group(3).split(",")] if m.group(3) else []
+    parts = dn.group(1).split("->")
+    if len(parts) != 2:
+        return None
+    kern_l = parts[0].split("_")[1]
+    lhs = tab.get(ops.group(1), ("?", []))
+    rhs = tab.get(ops.group(2), ("?", []))
+    rhs_dims = rhs[1]
+    if len(rhs_dims) != len(kern_l):
+        return None
+    out_elems = 1
+    for d in out_dims:
+        out_elems *= d
+    k_contract = 1
+    for ch, d in zip(kern_l, rhs_dims):
+        if ch == "i" or ch.isdigit():
+            k_contract *= d
+    fg = re.search(r"feature_group_count=(\d+)", line)
+    g = int(fg.group(1)) if fg else 1
+    bgm = re.search(r"batch_group_count=(\d+)", line)
+    bg = int(bgm.group(1)) if bgm else 1
+    win = re.search(r"window=\{([^}]*)\}", line)
+    flops = 2 * out_elems * k_contract
+    src = re.search(r'op_name="([^"]*)"', line)
+    return (flops, out_dt, out_dims, lhs[1], rhs_dims, dn.group(1), g, bg,
+            win.group(1) if win else "", src.group(1) if src else "")
+
+
+def dot_flops(line, tab):
+    """Analytic FLOPs of one HLO dot line (2*MACs)."""
+    m = DEF_RE.match(line)
+    ops = _DOT_OPS_RE.search(line)
+    cd = re.search(r"lhs_contracting_dims=\{([\d,]+)\}", line)
+    if not (m and ops and cd):
+        return None
+    out_dims = [int(x) for x in m.group(3).split(",")] if m.group(3) else []
+    lhs = tab.get(ops.group(1), ("?", []))
+    lhs_dims = lhs[1]
+    out_elems = 1
+    for d in out_dims:
+        out_elems *= d
+    contract = 1
+    for c in (int(x) for x in cd.group(1).split(",")):
+        if c < len(lhs_dims):
+            contract *= lhs_dims[c]
+    return 2 * out_elems * contract, m.group(2), out_dims, lhs_dims
+
+
+def parse_kind(line):
+    """'%x = bf16[1,2]{layout} fusion(...)' -> ('%x', 'fusion')"""
+    clean = re.sub(r"\{[^{}]*\}", "", line)
+    m = _KIND_RE.match(clean)
+    return (m.group(1), m.group(2)) if m else (None, None)
+
+
+def fallback_cat(name):
+    n = name.lstrip("%")
+    for k in ("copy", "convolution", "fusion", "convert", "reduce",
+              "select_and_scatter", "transpose", "bitcast", "broadcast"):
+        if n.startswith(k):
+            return k
+    return "other"
+
+
+def categorize_hlo(hlo):
+    """Map %instr name -> category using fusion bodies in optimized HLO."""
+    # computation name -> set of op kinds inside
+    comp_ops = {}
+    cur = None
+    for line in hlo.splitlines():
+        m = re.match(r"^(%[\w.\-]+)\s+\([^)]*\)\s*->", line)
+        if m:
+            cur = m.group(1)
+            comp_ops[cur] = set()
+            continue
+        if cur and line.startswith("}"):
+            cur = None
+            continue
+        if cur:
+            _, kind = parse_kind(line)
+            if kind:
+                comp_ops[cur].add(kind)
+    cat_of = {}
+    for line in hlo.splitlines():
+        name, kind = parse_kind(line)
+        if not name:
+            continue
+        if kind == "fusion":
+            mc = re.search(r"calls=(%[\w.\-]+)", line)
+            ops = comp_ops.get(mc.group(1), set()) if mc else set()
+            if "convolution" in ops:
+                cat_of[name] = "conv-fusion"
+            elif "dot" in ops:
+                cat_of[name] = "dot-fusion"
+            elif "scatter" in ops:
+                cat_of[name] = "scatter-fusion"
+            elif "reduce" in ops or "reduce_window" in ops:
+                cat_of[name] = "reduce-fusion"
+            else:
+                cat_of[name] = "elementwise-fusion"
+        elif kind == "convolution":
+            cat_of[name] = "conv-bare"
+        else:
+            cat_of[name] = kind
+    return cat_of
+
+
+def conv_descriptions(hlo):
+    """fusion/instr name -> conv config string inside it."""
+    tab = build_symtab(hlo)
+    # computation -> conv desc
+    comp_desc = {}
+    cur = None
+    for line in hlo.splitlines():
+        m = re.match(r"^(%[\w.\-]+)\s+\([^)]*\)\s*->", line)
+        if m:
+            cur = m.group(1)
+            continue
+        if cur and line.startswith("}"):
+            cur = None
+            continue
+        if cur and "convolution(" in line:
+            r = conv_flops(line, tab)
+            if r:
+                fl, dt, od, ld, rd, dl, g, bg, win, src = r
+                comp_desc[cur] = (f"naive_gflop={fl/1e9:<7.1f} out={od} "
+                                  f"lhs={ld} kern={rd} dl={dl} win=[{win}]")
+    desc = {}
+    for line in hlo.splitlines():
+        name, kind = parse_kind(line)
+        if not name:
+            continue
+        if kind == "fusion":
+            mc = re.search(r"calls=(%[\w.\-]+)", line)
+            if mc and mc.group(1) in comp_desc:
+                desc[name] = comp_desc[mc.group(1)]
+        elif kind == "convolution":
+            r = conv_flops(line, tab)
+            if r:
+                fl, dt, od, ld, rd, dl, g, bg, win, src = r
+                desc[name] = (f"naive_gflop={fl/1e9:<7.1f} out={od} "
+                              f"lhs={ld} kern={rd} dl={dl} win=[{win}]")
+    return desc
+
+
+def compiled_step(model, batch_data):
+    """The already-compiled fused-step executable for one warm step.
+
+    Runs one forward/backward/update (which compiles + registers the
+    program) and returns the SAME executable the model just ran via
+    ``FusedSymbolStep.compiled_program`` — no second lower+compile, and
+    the recorded cost/memory analyses in the compile registry describe
+    exactly this program. Falls back to an explicit compile only if the
+    retained handle is unavailable (e.g. a stale module).
+    """
+    model.forward(batch_data, is_train=True)
+    model.backward()
+    model.update()
+    fused = model._fused
+    feed = {fused.data_names[0]: batch_data.data[0].data,
+            fused.label_names[0]: batch_data.label[0].data}
+    exe = None
+    getter = getattr(fused, "compiled_program", None)
+    if callable(getter):
+        exe = getter(feed)
+    if exe is None:
+        exe = fused.lowered(feed).compile()
+    return fused, feed, exe

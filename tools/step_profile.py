@@ -4,13 +4,17 @@ Complements tools/hlo_breakdown.py (static FLOPs): runs the exact benched
 fused step under jax.profiler and aggregates device-side op durations from
 the xplane, so the slow HLOs are identified by measurement, not guessed.
 
+Round 14: HLO parsing/categorization helpers moved to
+``tools/hlo_util.py`` (shared with hlo_breakdown.py), and the profiled
+step's HLO comes from the executable the model itself compiled and
+registered — no second lower+compile.
+
 Usage: python tools/step_profile.py [batch] [--stem=s2d]
 """
 from __future__ import annotations
 
 import glob
 import os
-import re
 import sys
 import tempfile
 from collections import defaultdict
@@ -19,6 +23,12 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(
     os.path.abspath(__file__)), ".."))
+
+from hlo_util import (  # noqa: E402
+    categorize_hlo as _categorize_hlo,
+    conv_descriptions as _conv_descriptions,
+    fallback_cat as _fallback_cat,
+)
 
 
 def main():
@@ -123,108 +133,6 @@ def main():
                 shown += 1
                 if shown >= 40:
                     break
-
-
-def _conv_descriptions(hlo):
-    """fusion/instr name -> conv config string inside it."""
-    from hlo_breakdown import build_symtab, conv_flops
-    tab = build_symtab(hlo)
-    # computation -> conv desc
-    comp_desc = {}
-    cur = None
-    for line in hlo.splitlines():
-        m = re.match(r"^(%[\w.\-]+)\s+\([^)]*\)\s*->", line)
-        if m:
-            cur = m.group(1)
-            continue
-        if cur and line.startswith("}"):
-            cur = None
-            continue
-        if cur and "convolution(" in line:
-            r = conv_flops(line, tab)
-            if r:
-                fl, dt, od, ld, rd, dl, g, bg, win, src = r
-                comp_desc[cur] = (f"naive_gflop={fl/1e9:<7.1f} out={od} "
-                                  f"lhs={ld} kern={rd} dl={dl} win=[{win}]")
-    desc = {}
-    for line in hlo.splitlines():
-        name, kind = _parse_kind(line)
-        if not name:
-            continue
-        if kind == "fusion":
-            mc = re.search(r"calls=(%[\w.\-]+)", line)
-            if mc and mc.group(1) in comp_desc:
-                desc[name] = comp_desc[mc.group(1)]
-        elif kind == "convolution":
-            r = conv_flops(line, tab)
-            if r:
-                fl, dt, od, ld, rd, dl, g, bg, win, src = r
-                desc[name] = (f"naive_gflop={fl/1e9:<7.1f} out={od} "
-                              f"lhs={ld} kern={rd} dl={dl} win=[{win}]")
-    return desc
-
-
-def _fallback_cat(name):
-    n = name.lstrip("%")
-    for k in ("copy", "convolution", "fusion", "convert", "reduce",
-              "select_and_scatter", "transpose", "bitcast", "broadcast"):
-        if n.startswith(k):
-            return k
-    return "other"
-
-
-_KIND_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?(%[\w.\-]+)\s*=\s*(?:\([^)]*\)|\S+)\s+([\w\-]+)\(")
-
-
-def _parse_kind(line):
-    """'%x = bf16[1,2]{layout} fusion(...)' -> ('%x', 'fusion')"""
-    clean = re.sub(r"\{[^{}]*\}", "", line)
-    m = _KIND_RE.match(clean)
-    return (m.group(1), m.group(2)) if m else (None, None)
-
-
-def _categorize_hlo(hlo):
-    """Map %instr name -> category using fusion bodies in optimized HLO."""
-    # computation name -> set of op kinds inside
-    comp_ops = {}
-    cur = None
-    for line in hlo.splitlines():
-        m = re.match(r"^(%[\w.\-]+)\s+\([^)]*\)\s*->", line)
-        if m:
-            cur = m.group(1)
-            comp_ops[cur] = set()
-            continue
-        if cur and line.startswith("}"):
-            cur = None
-            continue
-        if cur:
-            _, kind = _parse_kind(line)
-            if kind:
-                comp_ops[cur].add(kind)
-    cat_of = {}
-    for line in hlo.splitlines():
-        name, kind = _parse_kind(line)
-        if not name:
-            continue
-        if kind == "fusion":
-            mc = re.search(r"calls=(%[\w.\-]+)", line)
-            ops = comp_ops.get(mc.group(1), set()) if mc else set()
-            if "convolution" in ops:
-                cat_of[name] = "conv-fusion"
-            elif "dot" in ops:
-                cat_of[name] = "dot-fusion"
-            elif "scatter" in ops:
-                cat_of[name] = "scatter-fusion"
-            elif "reduce" in ops or "reduce_window" in ops:
-                cat_of[name] = "reduce-fusion"
-            else:
-                cat_of[name] = "elementwise-fusion"
-        elif kind == "convolution":
-            cat_of[name] = "conv-bare"
-        else:
-            cat_of[name] = kind
-    return cat_of
 
 
 if __name__ == "__main__":

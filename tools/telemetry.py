@@ -8,8 +8,11 @@ operational surface:
     telemetry.py tail    [--dir D] [-n N] [--json] [--kind K]
     telemetry.py summary [--dir D] [--json]
     telemetry.py diff    A.json B.json [--json]
-                         [--gate-bytes] [--tolerance PCT]
+                         [--gate-bytes] [--gate-peak-mem]
+                         [--tolerance PCT]
     telemetry.py render  [--dir D]
+    telemetry.py fleet   [--dir D] [--json] [--straggler-factor F]
+    telemetry.py trace   [PATH] [--dir D] [--json]
 
 ``tail`` prints the last N events across the rotated segments (a line
 torn by a mid-write kill is skipped and counted, never fatal — the
@@ -22,6 +25,18 @@ between them: the r6 "strictly fewer bytes" pin generalized into the
 scriptable regression gate every fusion/pass PR runs (ROADMAP item 2);
 ``render`` emits the newest snapshot in Prometheus text format for a
 scrape endpoint or textfile collector.
+
+Round 14 adds the fleet and trace surfaces: ``fleet`` merges the
+per-rank ``rank-<r>/`` exporter directories a multi-process run writes
+under one base dir into fleet-wide step-time p50/p99 plus a per-rank
+skew table, flagging ranks whose median step wall exceeds
+``--straggler-factor`` x the fleet median (the straggler detector);
+``trace`` loads a Chrome trace-event JSON written under
+``MXTPU_TRACE_DIR`` (newest file by default), validates the event
+schema, and prints a per-category span summary — open the same file in
+``chrome://tracing`` / Perfetto for the visual timeline. ``diff
+--gate-peak-mem`` is the HBM sibling of ``--gate-bytes``: exit 2 when
+``mem::process_peak_bytes`` grew beyond tolerance between snapshots.
 
 Pure file-level operations: no accelerator backend is initialized.
 """
@@ -36,6 +51,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 os.pardir))
 
 BYTES_METRIC = "step::bytes_accessed"
+PEAK_MEM_METRIC = "mem::process_peak_bytes"
 
 
 def _dir(args):
@@ -194,6 +210,26 @@ def _load_bytes(tree, path):
              "snapshot/BENCH file, or the run recorded no step costs")
 
 
+def _load_peak_mem(tree, path):
+    """process-peak HBM bytes from a snapshot (``mem::`` gauge) or a
+    BENCH JSON (bench.py's ``memory.process_peak_bytes``)."""
+    m = tree.get("metrics", {}).get(PEAK_MEM_METRIC)
+    if isinstance(m, dict) and m.get("value"):
+        return float(m["value"])
+    mem = tree.get("memory")
+    if isinstance(mem, dict) and mem.get("process_peak_bytes"):
+        return float(mem["process_peak_bytes"])
+    t = tree.get("telemetry", {})
+    m = t.get("metrics", {}).get(PEAK_MEM_METRIC) if isinstance(t, dict) \
+        else None
+    if isinstance(m, dict) and m.get("value"):
+        return float(m["value"])
+    sys.exit(f"{path}: no {PEAK_MEM_METRIC} metric (and no "
+             "memory.process_peak_bytes field) — not a telemetry "
+             "snapshot/BENCH file, or the run recorded no program "
+             "memory analyses")
+
+
 def _flat_values(tree):
     """metric -> comparable scalar for the metric-by-metric diff."""
     out = {}
@@ -239,6 +275,19 @@ def cmd_diff(args):
             "tolerance_pct": args.tolerance,
             "regressed": gate_failed,
         }
+    mem_failed = False
+    if args.gate_peak_mem:
+        old_m = _load_peak_mem(old_t, args.old)
+        new_m = _load_peak_mem(new_t, args.new)
+        tol = args.tolerance / 100.0
+        mem_failed = new_m > old_m * (1.0 + tol)
+        result["gate_peak_mem"] = {
+            "old_peak_bytes": old_m,
+            "new_peak_bytes": new_m,
+            "delta_pct": round((new_m / old_m - 1.0) * 100.0, 4),
+            "tolerance_pct": args.tolerance,
+            "regressed": mem_failed,
+        }
     if args.json:
         print(json.dumps(result, indent=1))
     else:
@@ -250,6 +299,12 @@ def cmd_diff(args):
                   f"{g['new_bytes_per_step']:.6g} "
                   f"({g['delta_pct']:+.3f}%, tolerance "
                   f"{args.tolerance}%)")
+        if args.gate_peak_mem:
+            g = result["gate_peak_mem"]
+            print(f"peak HBM: {g['old_peak_bytes']:.6g} -> "
+                  f"{g['new_peak_bytes']:.6g} "
+                  f"({g['delta_pct']:+.3f}%, tolerance "
+                  f"{args.tolerance}%)")
     if gate_failed:
         print(f"BYTES REGRESSION: {BYTES_METRIC} grew "
               f"{result['gate_bytes']['delta_pct']:+.3f}% (> "
@@ -258,9 +313,224 @@ def cmd_diff(args):
               "bandwidth-bound regime that is a throughput regression "
               "(ROADMAP item 2's currency). Fix the pass or re-baseline "
               "deliberately.", file=sys.stderr)
+    if mem_failed:
+        print(f"PEAK-MEM REGRESSION: {PEAK_MEM_METRIC} grew "
+              f"{result['gate_peak_mem']['delta_pct']:+.3f}% (> "
+              f"{args.tolerance}% tolerance) — the process now needs "
+              "more HBM at peak than the baseline; on a real device "
+              "that margin is the difference between fitting and an "
+              "OOM at scale-up. Check donation/rematerialization or "
+              "re-baseline deliberately.", file=sys.stderr)
+    if gate_failed or mem_failed:
         return 2
     if args.gate_bytes:
         print("bytes gate OK", file=sys.stderr)
+    if args.gate_peak_mem:
+        print("peak-mem gate OK", file=sys.stderr)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# fleet aggregation / straggler detection (round 14)
+# ---------------------------------------------------------------------------
+def _pct(sorted_vals, q):
+    """Nearest-rank percentile of an already-sorted list."""
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _rank_dirs(base):
+    """``rank-<r>`` subdirectories of a fleet base dir, sorted by rank.
+
+    A single-process run writes straight into the base dir (no
+    ``rank-*`` layer), so when no subdirs exist the base itself is
+    treated as rank 0 — ``fleet`` degrades to a one-row table instead
+    of erroring.
+    """
+    out = []
+    try:
+        for name in os.listdir(base):
+            if name.startswith("rank-"):
+                try:
+                    r = int(name[len("rank-"):])
+                except ValueError:
+                    continue
+                path = os.path.join(base, name)
+                if os.path.isdir(path):
+                    out.append((r, path))
+    except OSError as e:
+        sys.exit(f"cannot list fleet dir {base}: {e}")
+    out.sort()
+    return out or [(0, base)]
+
+
+def fleet_summary(base, straggler_factor=1.5):
+    """Merge per-rank exporter dirs into one fleet view (the
+    ``fleet --json`` payload; the multi-process straggler test pins
+    this shape)."""
+    ranks = []
+    pooled = []
+    for r, path in _rank_dirs(base):
+        events, torn = _read_events(path)
+        walls = sorted(float(e["wall_s"]) for e in events
+                       if e.get("kind") == "train_step"
+                       and e.get("wall_s") is not None)
+        row = {
+            "rank": r,
+            "dir": path,
+            "events": len(events),
+            "torn_lines": torn,
+            "steps": len(walls),
+        }
+        if walls:
+            row["mean_wall_s"] = round(_mean(walls), 6)
+            row["p50_wall_s"] = round(_pct(walls, 50), 6)
+            row["p99_wall_s"] = round(_pct(walls, 99), 6)
+            pooled.extend(walls)
+        ranks.append(row)
+    # skew is judged on each rank's MEDIAN step wall, not its mean: the
+    # first step of every rank is compile-dominated and would mask a
+    # slow rank behind a shared multi-second outlier
+    p50s = sorted(r["p50_wall_s"] for r in ranks if "p50_wall_s" in r)
+    median = _pct(p50s, 50) if p50s else None
+    stragglers = []
+    for row in ranks:
+        if median and row.get("p50_wall_s"):
+            skew = row["p50_wall_s"] / median
+            row["skew"] = round(skew, 4)
+            row["straggler"] = skew >= straggler_factor
+            if row["straggler"]:
+                stragglers.append(row["rank"])
+    pooled.sort()
+    out = {
+        "dir": base,
+        "ranks": ranks,
+        "world": len(ranks),
+        "straggler_factor": straggler_factor,
+        "stragglers": stragglers,
+    }
+    if pooled:
+        out["fleet"] = {
+            "steps": len(pooled),
+            "mean_wall_s": round(_mean(pooled), 6),
+            "p50_wall_s": round(_pct(pooled, 50), 6),
+            "p99_wall_s": round(_pct(pooled, 99), 6),
+            "median_rank_p50_s": round(median, 6),
+        }
+    return out
+
+
+def cmd_fleet(args):
+    out = fleet_summary(_dir(args), args.straggler_factor)
+    if args.json:
+        print(json.dumps(out, indent=1))
+        return 0
+    print(f"fleet dir: {out['dir']}  ({out['world']} rank(s))")
+    fl = out.get("fleet")
+    if fl:
+        print(f"fleet steps: {fl['steps']}  mean {fl['mean_wall_s']}s  "
+              f"p50 {fl['p50_wall_s']}s  p99 {fl['p99_wall_s']}s")
+    for row in out["ranks"]:
+        if "mean_wall_s" not in row:
+            print(f"  rank {row['rank']}: no train_step events")
+            continue
+        flag = "  <-- STRAGGLER" if row.get("straggler") else ""
+        print(f"  rank {row['rank']}: {row['steps']} step(s), mean "
+              f"{row['mean_wall_s']}s, p99 {row['p99_wall_s']}s, "
+              f"skew x{row.get('skew', 1.0)}{flag}")
+    if out["stragglers"]:
+        print(f"stragglers (>= x{out['straggler_factor']} median rank "
+              f"p50): {out['stragglers']}", file=sys.stderr)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace inspection (round 14)
+# ---------------------------------------------------------------------------
+_TRACE_PH_REQUIRED = {
+    "X": ("name", "cat", "ph", "ts", "dur", "pid", "tid"),
+    "M": ("name", "ph", "pid"),
+}
+
+
+def validate_trace(tree, path="<trace>"):
+    """Chrome trace-event schema check; returns the event list.
+
+    Exits with a message naming the first offending event — the same
+    validation the trace tests run, so a file this accepts loads in
+    ``chrome://tracing``/Perfetto.
+    """
+    events = tree.get("traceEvents")
+    if not isinstance(events, list):
+        sys.exit(f"{path}: no traceEvents list — not a Chrome trace")
+    for i, e in enumerate(events):
+        ph = e.get("ph")
+        req = _TRACE_PH_REQUIRED.get(ph)
+        if req is None:
+            sys.exit(f"{path}: event {i} has unsupported ph={ph!r}")
+        for field in req:
+            if field not in e:
+                sys.exit(f"{path}: event {i} (ph={ph}) missing "
+                         f"required field {field!r}")
+        if ph == "X" and (not isinstance(e["ts"], (int, float))
+                          or e["ts"] < 0 or e["dur"] < 0):
+            sys.exit(f"{path}: event {i} has invalid ts/dur")
+    return events
+
+
+def cmd_trace(args):
+    path = args.path
+    if not path:
+        from mxnet_tpu.telemetry import trace as _trace
+        directory = args.dir or _trace.trace_dir()
+        if not directory:
+            sys.exit("no trace file: pass PATH, --dir, or set "
+                     "MXTPU_TRACE_DIR")
+        files = _trace.trace_files(directory)
+        if not files:
+            sys.exit(f"no trace-*.json under {directory}")
+        path = files[-1]
+    try:
+        with open(path) as f:
+            tree = json.load(f)
+    except (OSError, ValueError) as e:
+        sys.exit(f"cannot read trace {path}: {e}")
+    events = validate_trace(tree, path)
+    spans = [e for e in events if e.get("ph") == "X"]
+    if args.json:
+        cats = {}
+        for e in spans:
+            c = cats.setdefault(e.get("cat", "?"),
+                                {"spans": 0, "total_us": 0.0})
+            c["spans"] += 1
+            c["total_us"] = round(c["total_us"] + e["dur"], 3)
+        print(json.dumps({
+            "path": path,
+            "events": len(events),
+            "spans": len(spans),
+            "dropped_spans": tree.get("otherData", {})
+                                 .get("dropped_spans", 0),
+            "by_cat": cats,
+        }, indent=1))
+        return 0
+    print(f"trace: {path}")
+    print(f"events: {len(events)} ({len(spans)} span(s))")
+    dropped = tree.get("otherData", {}).get("dropped_spans", 0)
+    if dropped:
+        print(f"dropped spans (ring overflow): {dropped}")
+    by_name = {}
+    for e in spans:
+        key = (e.get("cat", "?"), e["name"])
+        cnt, tot = by_name.get(key, (0, 0.0))
+        by_name[key] = (cnt + 1, tot + e["dur"])
+    for (cat, name), (cnt, tot) in sorted(
+            by_name.items(), key=lambda kv: -kv[1][1]):
+        print(f"  {cat:<8} {name:<28} x{cnt:<5} {tot / 1e3:.3f} ms")
+    print("open in chrome://tracing or https://ui.perfetto.dev for "
+          "the timeline view")
     return 0
 
 
@@ -302,11 +572,35 @@ def main(argv=None):
     p.add_argument("--gate-bytes", action="store_true",
                    help="exit 2 when step::bytes_accessed grew beyond "
                         "--tolerance")
+    p.add_argument("--gate-peak-mem", action="store_true",
+                   help="exit 2 when mem::process_peak_bytes grew "
+                        "beyond --tolerance")
     p.add_argument("--tolerance", type=float, default=0.0,
-                   help="allowed bytes growth in percent (default 0: "
-                        "strictly no more bytes)")
+                   help="allowed growth in percent (default 0: "
+                        "strictly no regression)")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_diff)
+
+    p = sub.add_parser("fleet",
+                       help="merge per-rank exporter dirs; flag "
+                            "straggler ranks")
+    p.add_argument("--dir", default=None,
+                   help="fleet base dir holding rank-<r>/ subdirs")
+    p.add_argument("--straggler-factor", type=float, default=1.5,
+                   help="flag ranks whose median step wall exceeds this "
+                        "multiple of the fleet median (default 1.5)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_fleet)
+
+    p = sub.add_parser("trace",
+                       help="validate + summarize a Chrome trace-event "
+                            "JSON (newest under MXTPU_TRACE_DIR by "
+                            "default)")
+    p.add_argument("path", nargs="?", default=None)
+    p.add_argument("--dir", default=None,
+                   help="trace directory (default: MXTPU_TRACE_DIR)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser("render",
                        help="newest snapshot in Prometheus text format")
