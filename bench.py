@@ -56,6 +56,47 @@ def _peak_flops(device) -> float:
     return 0.0  # unknown (e.g. CPU) -> mfu reported as 0
 
 
+def tuned_vs_default(max_trials=8, seed=0):
+    """Run the r15 autotuner's built-in CPU-proxy searches (tune/) and
+    report tuned vs default on the deterministic bytes objective — the
+    closed-loop answer to "did searching the measured space actually
+    beat the hand-set defaults?". Fresh search every run (throwaway
+    store), so the number is re-earned, never replayed."""
+    import tempfile
+    import mxnet_tpu as mx
+    out = {}
+    for family in ("conv", "sparse"):
+        try:
+            wl = mx.tune.workloads.builtin_workload(family)
+            store = mx.tune.TuneStore(
+                tempfile.mkdtemp(prefix=f"mxtune_bench_{family}_"))
+            rec = mx.tune.autotune(wl, store=store, seed=seed,
+                                   max_trials=max_trials)
+            out[family] = {
+                "workload": rec.name,
+                "objective": rec.objective,
+                "default": rec.default_value,
+                "tuned": rec.best_value,
+                "improvement": round(rec.improvement(), 4),
+                "strict_improvement": bool(
+                    rec.default_value is not None
+                    and rec.best_value is not None
+                    and rec.best_value < rec.default_value),
+                "best_config": rec.best_config,
+                "trials": rec.trials,
+                "search_wall_s": round(rec.search_wall_s, 2),
+            }
+        except Exception as exc:  # a family failing shouldn't kill BENCH
+            out[family] = {"error": f"{type(exc).__name__}: {exc}"}
+    out["note"] = (
+        "mx.tune.autotune over the built-in proxy workloads (pass "
+        "flags x Pallas tiles x batch, objective = XLA cost-analysis "
+        "bytes per row of the fused train step); 'tuned' must be "
+        "strictly below 'default' — the search re-finds the pass-"
+        "fusion + batch-amortization wins from measurement alone")
+    return out
+
+
 def main():
     import jax
     import mxnet_tpu as mx
@@ -766,6 +807,13 @@ print("BENCH " + json.dumps({
     except Exception:
         pass
 
+    # -- phase I: autotuning (round 15, mxnet_tpu/tune/) ---------------------
+    autotune_stats = None
+    try:
+        autotune_stats = tuned_vs_default(max_trials=8)
+    except Exception:
+        pass
+
     # -- HBM accounting (round 14): per-program peaks + process peak
     # from the compile registry's recorded memory_analysis — the
     # baseline `tools/telemetry.py diff --gate-peak-mem` compares
@@ -871,6 +919,7 @@ print("BENCH " + json.dumps({
         "input_pipeline": ip_stats,
         "cold_start": cold_start,
         "sparse_embedding": sparse_stats,
+        "autotune": autotune_stats,
         "memory": memory_stats,
         "telemetry": telemetry_snapshot,
         "host_decode_note": "multiprocess RecordIO->decode->augment->"
@@ -885,4 +934,12 @@ print("BENCH " + json.dumps({
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "tuned_vs_default":
+        # standalone fast mode: just the autotune section, one JSON line
+        print("BENCH " + json.dumps(
+            {"metric": "tuned_vs_default",
+             "autotune": tuned_vs_default(
+                 max_trials=int(sys.argv[2]) if len(sys.argv) > 2
+                 else 8)}))
+    else:
+        main()

@@ -76,6 +76,8 @@ from . import checkpoint
 from .checkpoint import CheckpointManager
 from . import sparse
 from .sparse import sparse_report
+from . import tune
+from .tune import tune_report
 from . import contrib
 from . import gluon
 from . import rnn

@@ -225,6 +225,31 @@ register("MXTPU_TRACE_ANNOTATE", True, bool,
          "Mirror trace spans as jax.profiler.TraceAnnotation while a "
          "jax trace runs, so host spans and device timelines correlate "
          "by name in the same profile")
+register("MXTPU_PALLAS_TILES", "", str,
+         "Pallas fused-kernel output-tile override '<bm>,<bn>' "
+         "(ops/pallas_fused.py): tried first by select_tiles/"
+         "select_conv_tiles when it divides the shape. Values must be "
+         "positive multiples of 8 within the built-in candidate bounds "
+         "(bm<=1024, bn<=512) — invalid values raise MXNetError at "
+         "selection time (a bad tile fails the tuner trial, not the "
+         "process). Empty = built-in largest-dividing selection")
+register("MXTPU_TUNE_DIR", "", str,
+         "TuningRecord store directory (tune/record.py). Empty = "
+         "<MXTPU_COMPILE_CACHE_DIR>/tune when the compile cache is "
+         "configured, else tuning-record persistence is off")
+register("MXTPU_TUNE_CACHE", "auto", str,
+         "Tuning-record persistence switch: 1/auto = on when a store "
+         "directory resolves, 0 = search-only (no records written or "
+         "read; mx.tune_report() observability stays on)")
+register("MXTPU_TUNE_MAX_TRIALS", 0, int,
+         "Trial-count ceiling per search: spaces larger than this are "
+         "sampled (seeded, deterministic) instead of enumerated; "
+         "0 = exhaustive enumeration")
+register("MXTPU_TUNE_HBM_BUDGET", 0, int,
+         "Peak-HBM headroom budget in bytes for the tuner's static "
+         "pruning: batch-size candidates whose compiled train-step "
+         "proxy reports memory_analysis peak above this are pruned "
+         "without a measured trial; 0 = no HBM pruning")
 register("MXTPU_COMPILE_JAX_CACHE", True, bool,
          "Also point JAX's own persistent compilation cache at "
          "CACHE_DIR/xla (a second, backend-level layer on TPU/GPU; "

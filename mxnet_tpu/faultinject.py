@@ -3,7 +3,8 @@
 Faults are armed **by site and ordinal**, never randomly: a spec names a
 site (``ckpt_write``, ``nan_grad``, ``data_iter``, ``data_worker``,
 ``dist_drop``, ``dist_init``, ``ckpt_truncate``, ``compile_cache``,
-``telemetry_write``, ``sparse_update``, ``slow_step``) plus
+``telemetry_write``, ``sparse_update``, ``slow_step``,
+``tune_trial``) plus
 the exact coordinate at which it fires (byte offset, step index, batch
 index, call ordinal). ``telemetry_write`` is consulted by the durable
 telemetry exporter (telemetry/export.py) on every event append
@@ -24,7 +25,14 @@ state bit-for-bit. ``slow_step`` is consulted at the top of every fused
 train step; with ``action=sleep:ms=N`` it stretches each step by N
 milliseconds — the deterministic straggler-rank drill behind the fleet
 telemetry aggregator's skew flagging (arm it in ONE rank's environment
-and ``tools/telemetry.py fleet`` must name that rank). The same spec
+and ``tools/telemetry.py fleet`` must name that rank). ``tune_trial``
+covers the autotuner (tune/): ``trial=N`` fires at the N-th trial's
+commit boundary in the search loop (``action=kill`` is the
+SIGKILL-mid-search drill — the trial journal must hold only complete,
+CRC-valid lines and the resumed search must reuse them), while
+``byte=N`` / ``bytes=N`` arm the TuningRecord write itself
+(mid-write death / post-rename truncation, which the record CRC must
+catch on load). The same spec
 always produces the same failure, so CI chaos suites are reproducible
 bit-for-bit (contrast: the classic chaos-monkey coin flip, useless as a
 regression gate).

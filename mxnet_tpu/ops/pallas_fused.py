@@ -38,6 +38,7 @@ ops, so tier-1 CPU tests exercise the same op, rewrite, and VJP.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -53,13 +54,65 @@ __all__ = ["bn_relu_matmul", "bn_relu_conv_nchw", "select_tiles",
 _BM_CANDIDATES = (1024, 512, 256, 128, 64, 32, 16, 8)
 _BN_CANDIDATES = (512, 256, 128, 64, 32, 16, 8)
 
+# MXTPU_PALLAS_TILES parse cache: (raw env string, parsed (bm, bn))
+_TILE_OVERRIDE_CACHE = ("", None)
+
+
+def _tile_override():
+    """The ``MXTPU_PALLAS_TILES`` override — ``"<bm>,<bn>"``, the
+    candidate pair tried FIRST by :func:`select_tiles` (and, mapped to
+    (bs, bo), by :func:`select_conv_tiles`) before the built-in
+    largest-first scan. This is the tuner's per-trial tile knob.
+
+    Validation is loud and strict: two positive integers, each a
+    multiple of 8 (MXU sublane alignment — see the TPU tile-shape
+    table), bounded by the built-in candidate maxima (bm ≤ 1024,
+    bn ≤ 512). Anything else raises MXNetError at selection time, so a
+    bad tile fails the BIND/TRIAL that consulted it, never the process
+    and never silently. A valid tile that merely doesn't divide the
+    shape at hand is not an error — selection falls back to the
+    built-in candidates (the knob steers, the shape decides)."""
+    global _TILE_OVERRIDE_CACHE
+    raw = os.environ.get("MXTPU_PALLAS_TILES", "").strip()
+    if not raw:
+        return None
+    if _TILE_OVERRIDE_CACHE[0] == raw:
+        return _TILE_OVERRIDE_CACHE[1]
+    from ..base import MXNetError
+
+    def bad(why):
+        return MXNetError(
+            f"MXTPU_PALLAS_TILES={raw!r} is invalid ({why}): expected "
+            f"'<bm>,<bn>' with positive multiples of 8, bm <= "
+            f"{_BM_CANDIDATES[0]}, bn <= {_BN_CANDIDATES[0]}")
+
+    parts = [p.strip() for p in raw.split(",")]
+    if len(parts) != 2:
+        raise bad("need exactly two comma-separated values")
+    try:
+        bm, bn = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise bad("non-integer value")
+    if bm <= 0 or bn <= 0:
+        raise bad("non-positive tile")
+    if bm % 8 or bn % 8:
+        raise bad("not a multiple of 8")
+    if bm > _BM_CANDIDATES[0] or bn > _BN_CANDIDATES[0]:
+        raise bad("out of bounds")
+    _TILE_OVERRIDE_CACHE = (raw, (bm, bn))
+    return (bm, bn)
+
 
 def select_tiles(m, n):
     """(bm, bn) output-tile split for an (M, K) @ (K, N) fused matmul,
     or None when no candidate divides (a truncated grid would leave
-    output tiles uninitialized)."""
-    bm = next((c for c in _BM_CANDIDATES if m % c == 0), None)
-    bn = next((c for c in _BN_CANDIDATES if n % c == 0), None)
+    output tiles uninitialized). An ``MXTPU_PALLAS_TILES`` override is
+    preferred per dimension when it divides."""
+    ov = _tile_override()
+    bm = ov[0] if ov is not None and m % ov[0] == 0 else \
+        next((c for c in _BM_CANDIDATES if m % c == 0), None)
+    bn = ov[1] if ov is not None and n % ov[1] == 0 else \
+        next((c for c in _BN_CANDIDATES if n % c == 0), None)
     if bm is None or bn is None:
         return None
     return bm, bn
@@ -71,9 +124,15 @@ def select_conv_tiles(n_out, spatial):
     rewrite pass's bail-out rule). Output channels must divide by an
     8-multiple candidate (MXU sublane alignment); the spatial dim may
     instead be taken whole when small, because odd per-sample extents
-    (7·7=49, 14·14=196) are the NORM mid-network and still block fine."""
-    bo = next((c for c in _BN_CANDIDATES if n_out % c == 0), None)
-    bs = next((c for c in _BM_CANDIDATES if spatial % c == 0), None)
+    (7·7=49, 14·14=196) are the NORM mid-network and still block fine.
+    An ``MXTPU_PALLAS_TILES`` override ``"<bm>,<bn>"`` maps to
+    (bs, bo) — bm is the spatial-like dim, bn the channel-like one —
+    and is preferred per dimension when it divides."""
+    ov = _tile_override()
+    bo = ov[1] if ov is not None and n_out % ov[1] == 0 else \
+        next((c for c in _BN_CANDIDATES if n_out % c == 0), None)
+    bs = ov[0] if ov is not None and spatial % ov[0] == 0 else \
+        next((c for c in _BM_CANDIDATES if spatial % c == 0), None)
     if bs is None and spatial <= 1024:
         bs = int(spatial)
     if bo is None or bs is None:

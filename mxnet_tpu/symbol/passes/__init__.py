@@ -33,8 +33,9 @@ dumps decisions for a symbol JSON and gates CI with ``--assert-bytes``.
 from .base import GraphPass, PassContext, rebuild_graph, resolve_flag, \
     flag_active
 from .manager import (PassManager, apply_pipeline, default_manager,
-                      legacy_fusion_entry, measure_symbol_bytes,
-                      pass_report, pipeline_key_material)
+                      legacy_fusion_entry, measure_memo_scope,
+                      measure_symbol_bytes, pass_report,
+                      pipeline_key_material, reset_measure_memo)
 from .pallas_fusion import PallasFusionPass
 from .residual_fusion import ResidualFusionPass
 from .bn_fold import BNFoldPass
@@ -42,7 +43,8 @@ from .bf16_cast import Bf16CastPass
 
 __all__ = ["GraphPass", "PassContext", "PassManager", "apply_pipeline",
            "default_manager", "legacy_fusion_entry",
-           "measure_symbol_bytes", "pass_report",
-           "pipeline_key_material", "rebuild_graph", "resolve_flag",
+           "measure_memo_scope", "measure_symbol_bytes", "pass_report",
+           "pipeline_key_material", "reset_measure_memo",
+           "rebuild_graph", "resolve_flag",
            "flag_active", "PallasFusionPass", "ResidualFusionPass",
            "BNFoldPass", "Bf16CastPass"]

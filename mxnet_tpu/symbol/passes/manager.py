@@ -29,6 +29,7 @@ same store (symbol/fusion.py delegates here).
 """
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -39,7 +40,8 @@ from .base import GraphPass, PassContext, flag_active
 
 __all__ = ["PassManager", "default_manager", "apply_pipeline",
            "pass_report", "legacy_fusion_entry", "pipeline_key_material",
-           "measure_symbol_bytes", "collect_fusion"]
+           "measure_symbol_bytes", "collect_fusion",
+           "measure_memo_scope", "reset_measure_memo"]
 
 # pipeline records, most recent last (shared by pass_report and the
 # legacy fusion_report view; each view consumes independently via its
@@ -51,6 +53,36 @@ _LOCK = threading.RLock()
 # (graph digest, shapes, mode) -> measured bytes-accessed
 _MEASURE_MEMO: Dict[tuple, Optional[float]] = {}
 _MEASURE_MEMO_MAX = 128
+
+
+def reset_measure_memo():
+    """Drop every memoized bytes measurement. The memo key is (graph,
+    shapes, mode, hoist set) ONLY — anything that changes the LOWERING
+    of an unchanged graph (``MXTPU_PALLAS_TILES``, a backend flip) must
+    reset it or a later measurement silently reuses a number taken
+    under the old regime."""
+    with _LOCK:
+        _MEASURE_MEMO.clear()
+
+
+@contextlib.contextmanager
+def measure_memo_scope():
+    """Isolate the measurement memo for one scope (the tuner wraps
+    every trial in this): entries memoized before the scope are not
+    visible inside it, and entries measured inside are discarded on
+    exit. Two trials differing only in env regime — same graph JSON,
+    different ``MXTPU_PALLAS_TILES`` — therefore never share a
+    measurement, while the ambient memo (binds outside any trial) is
+    preserved across the search."""
+    with _LOCK:
+        saved = dict(_MEASURE_MEMO)
+        _MEASURE_MEMO.clear()
+    try:
+        yield
+    finally:
+        with _LOCK:
+            _MEASURE_MEMO.clear()
+            _MEASURE_MEMO.update(saved)
 
 
 def _record(report: dict):

@@ -1,0 +1,180 @@
+"""mx.tune: search-driven autotuning over the measured config space.
+
+r11–r14 built the measurement stack — per-pass XLA bytes deltas,
+per-program ``memory_analysis()`` peak HBM, per-bucket serving
+p50/p99, step-phase wall attribution — and left every knob those
+measurements could drive hand-set. This subsystem closes the loop, in
+the spirit of TVM's measured search (PAPERS.md): a declarative
+:class:`~.space.SearchSpace` over the knobs the framework already
+exposes, a deterministic :class:`~.runner.TrialRunner` (static pruning
+→ measured trials → successive halving), and CRC-guarded
+:class:`~.record.TuningRecord` persistence keyed like the compile
+registry — so a tuned process boots tuned, with zero re-search.
+
+Entry point::
+
+    import mxnet_tpu as mx
+    wl = mx.tune.workloads.conv_proxy(batch=8)
+    rec = mx.tune.autotune(wl)        # warm hit or search-and-record
+    params = rec.apply()              # env knobs exported; params dict
+                                      # (batch, buckets...) returned
+
+Observability: the ``tune`` telemetry collector (``mx.tune_report()``)
+carries trials run/pruned/reused/failed, warm hits, records
+written/rejected, and per-search summaries with the best-vs-default
+delta; flat ``tune::*`` counters/gauges mirror into
+``mx.telemetry.report()``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from ..telemetry import registry as _treg
+
+__all__ = ["SearchSpace", "Knob", "Trial", "TrialRunner",
+           "TuningRecord", "TuneStore", "TrialJournal", "TuneRecordError",
+           "default_store", "autotune", "tune_report",
+           "space", "record", "runner", "workloads"]
+
+_LOCK = threading.Lock()
+_COUNTER_KEYS = ("trials_run", "trials_pruned", "trials_reused",
+                 "trials_failed", "warm_hits", "records_written",
+                 "records_rejected", "journal_lines_rejected",
+                 "searches")
+_STATS = {k: 0 for k in _COUNTER_KEYS}
+_SEARCHES: List[dict] = []
+_MAX_SEARCHES = 32
+
+
+def _note(key: str, n: int = 1):
+    """Count once into both layers: the collector's local store and the
+    flat ``tune::`` registry counter."""
+    with _LOCK:
+        _STATS[key] = _STATS.get(key, 0) + n
+    _treg.counter(f"tune::{key}").inc(n)
+
+
+def _note_search(summary: dict):
+    with _LOCK:
+        _SEARCHES.append(summary)
+        del _SEARCHES[:-_MAX_SEARCHES]
+    _treg.gauge(f"tune::{summary['name']}::best_vs_default").set(
+        summary.get("improvement") or 0.0)
+
+
+def _collect(reset: bool = False) -> dict:
+    with _LOCK:
+        out = {k: _STATS.get(k, 0) for k in _COUNTER_KEYS}
+        out["recent_searches"] = list(_SEARCHES)
+        if reset:
+            for k in _STATS:
+                _STATS[k] = 0
+            _SEARCHES.clear()
+    return out
+
+
+tune_report = _treg.collector_view("tune", _collect)
+
+from . import space          # noqa: E402
+from . import record         # noqa: E402
+from . import runner         # noqa: E402
+from . import workloads      # noqa: E402
+from .space import SearchSpace, Knob                    # noqa: E402
+from .record import (TuningRecord, TuneStore, TrialJournal,  # noqa: E402
+                     TuneRecordError, default_store)
+from .runner import Trial, TrialRunner                  # noqa: E402
+
+
+def autotune(workload, *, store=None, seed: int = 0,
+             max_trials: Optional[int] = None, force: bool = False,
+             apply: bool = False, on_trial=None, **runner_kwargs):
+    """Tune one workload: boot from a valid stored record when one
+    exists (zero trials, zero measurement compiles — the warm path),
+    else run the search, persist the winner, and return its
+    :class:`TuningRecord`.
+
+    ``store=None`` uses :func:`default_store` (``MXTPU_TUNE_DIR`` /
+    ``<MXTPU_COMPILE_CACHE_DIR>/tune``; may itself be None = no
+    persistence). ``force=True`` re-searches even over a valid record.
+    ``apply=True`` exports the winner's env knobs into ``os.environ``
+    before returning (the boot-tuned path; param knobs come back via
+    ``record.param_items()``).
+
+    The search ALWAYS measures the space's default configuration, so
+    ``default_value`` is measured, never assumed; when no explored
+    configuration strictly beats it, the record stores the default as
+    best (tuning never regresses the workload).
+    """
+    if store is None:
+        store = default_store()
+    key = workload.key()
+    if store is not None and store.enabled and not force:
+        rec = store.load(key.digest)
+        if rec is not None:
+            _note("warm_hits")
+            if apply:
+                rec.apply()
+            return rec
+
+    journal = None
+    if store is not None and store.enabled:
+        import os
+        os.makedirs(store.directory, exist_ok=True)
+        journal = TrialJournal(store.journal_path(key.digest))
+    t0 = time.time()
+    r = TrialRunner(workload.space, workload.measure,
+                    static=workload.static, seed=seed,
+                    max_trials=max_trials, journal=journal,
+                    on_trial=on_trial, name=workload.name,
+                    **runner_kwargs)
+    best, trials = r.search()
+    wall = time.time() - t0
+
+    default_cfg = workload.space.default_config()
+    default_id = workload.space.config_id(default_cfg)
+    default_t = next((t for t in trials if t.config_id == default_id),
+                     None)
+    default_value = default_t.objective if default_t is not None \
+        else None
+    if best is None or (default_value is not None
+                        and best.objective is not None
+                        and best.objective >= default_value):
+        best_cfg, best_value = default_cfg, default_value
+    else:
+        best_cfg, best_value = best.config, best.objective
+
+    counts = {"run": sum(t.status == "measured" for t in trials),
+              "pruned": sum(t.status == "pruned" for t in trials),
+              "reused": sum(t.status == "reused" for t in trials),
+              "failed": sum(t.status == "failed" for t in trials)}
+    rec = TuningRecord({
+        "digest": key.digest,
+        "name": workload.name,
+        "workload": getattr(workload, "builtin", None),
+        "objective": workload.objective,
+        "space": workload.space.describe(),
+        "default_config": default_cfg,
+        "default_value": default_value,
+        "best_config": best_cfg,
+        "best_value": best_value,
+        "trials": counts,
+        "search_wall_s": wall,
+        "created": time.time(),
+        "seed": int(seed),
+    })
+    _note("searches")
+    _note_search({"name": workload.name, "digest": key.digest,
+                  "objective": workload.objective,
+                  "default": default_value, "best": best_value,
+                  "improvement": rec.improvement(),
+                  "trials": counts, "wall_s": wall})
+    if store is not None and store.enabled:
+        store.put(rec)
+        _note("records_written")
+        if journal is not None:
+            journal.remove()   # the record supersedes the crash log
+    if apply:
+        rec.apply()
+    return rec

@@ -1,0 +1,354 @@
+"""Tuning-record persistence: a tuned process boots tuned.
+
+One file per tuned workload, named by the key digest (the same
+canonical material discipline as the r10 compile registry — symbol
+digest, input shapes, optimizer, mesh, backend identity — plus the
+search space itself)::
+
+    <dir>/<sha256-digest>.mxtune
+
+Entry layout mirrors the compile cache's (compile/cache.py), and for
+the same reasons::
+
+    b"MXTUNE1\\n"                     magic
+    uint32 big-endian header length
+    header JSON   {version, digest, name, kind, fingerprint, crc32,
+                   payload_len, created}
+    payload       record JSON
+
+Every write is atomic (``base.atomic_write``: temp + fsync + rename) —
+a search SIGKILLed at any byte never tears an existing record. On read
+a record is rejected loudly (warning + ``tune::records_rejected``
+counter), never applied, when the magic/header/CRC don't check out
+(``corrupt``) or the stored version fingerprint differs from the
+running stack (``stale``); the caller falls back to a fresh search
+that overwrites the entry. The ``tune_trial`` fault-injection site
+covers both failure shapes (``byte=N`` dies mid-write, ``bytes=N``
+truncates after the rename commits).
+
+The directory defaults to ``<MXTPU_COMPILE_CACHE_DIR>/tune`` — tuning
+records live alongside the compiled programs they select — and is
+overridable via ``MXTPU_TUNE_DIR``.
+
+:class:`TrialJournal` is the search's crash log: one CRC-guarded JSON
+line per completed trial, appended as trials finish. A resumed search
+replays completed trials from the journal instead of re-measuring; a
+torn final line (the kill landed mid-append) fails its CRC and is
+skipped, losing at most the one in-flight trial.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import struct
+import time
+import zlib
+
+from ..base import MXNetError, atomic_write
+
+__all__ = ["TuningRecord", "TuneRecordError", "TuneStore",
+           "TrialJournal", "default_store"]
+
+_MAGIC = b"MXTUNE1\n"
+_SUFFIX = ".mxtune"
+_log = logging.getLogger("mxnet_tpu.tune")
+
+
+class TuneRecordError(MXNetError):
+    """A tuning record exists but must not be applied. ``reason`` is
+    ``"corrupt"`` (magic/CRC/length mismatch) or ``"stale"`` (version
+    fingerprint mismatch)."""
+
+    def __init__(self, path, reason, detail=""):
+        super().__init__(
+            f"tuning record '{os.path.basename(path)}' is {reason}"
+            f"{': ' + detail if detail else ''}; falling back to a "
+            "fresh search (the record will be overwritten)")
+        self.path = path
+        self.reason = reason
+
+
+class TuningRecord:
+    """The winning configuration of one search, plus everything needed
+    to judge it later: the measured objective of the default and best
+    configurations, the knob kinds (so :meth:`env_items` can re-apply
+    the env half), trial counts, and the search wall time."""
+
+    __slots__ = ("data",)
+
+    _FIELDS = ("digest", "name", "workload", "objective", "space",
+               "default_config", "default_value", "best_config",
+               "best_value", "trials", "search_wall_s", "created",
+               "seed")
+
+    def __init__(self, data: dict):
+        missing = [f for f in self._FIELDS if f not in data]
+        if missing:
+            raise ValueError(f"TuningRecord missing fields: {missing}")
+        self.data = dict(data)
+
+    def __getattr__(self, name):
+        try:
+            return self.data[name]
+        except KeyError:
+            raise AttributeError(name)
+
+    def env_items(self):
+        """[(env var, value)] of the best config's env-kind knobs."""
+        kinds = {k["name"]: k["kind"] for k in self.space["knobs"]}
+        return [(n, v) for n, v in sorted(self.best_config.items())
+                if kinds.get(n) == "env"]
+
+    def param_items(self):
+        kinds = {k["name"]: k["kind"] for k in self.space["knobs"]}
+        return {n: v for n, v in self.best_config.items()
+                if kinds.get(n) == "param"}
+
+    def improvement(self):
+        """Fractional objective reduction of best over default (0.0
+        when the search couldn't beat the default)."""
+        d, b = self.default_value, self.best_value
+        if not d or b is None:
+            return 0.0
+        return max(0.0, 1.0 - float(b) / float(d))
+
+    def apply(self, environ=None):
+        """Export the env half of the best config into ``environ``
+        (default ``os.environ``) — the boot-time application path; the
+        param half is returned for the caller to feed its constructors
+        (batch size, bucket set...)."""
+        env = os.environ if environ is None else environ
+        for name, value in self.env_items():
+            if value is None or value == "":
+                env.pop(name, None)
+            else:
+                env[name] = str(value)
+        return self.param_items()
+
+    def __repr__(self):
+        return (f"TuningRecord({self.name!r}@{self.digest[:10]}, "
+                f"{self.objective}: {self.default_value} -> "
+                f"{self.best_value})")
+
+
+class TuneStore:
+    """CRC-guarded atomic record store (see module docstring)."""
+
+    def __init__(self, directory):
+        self.directory = os.fspath(directory)
+
+    @property
+    def enabled(self):
+        return bool(self.directory)
+
+    def path_for(self, digest):
+        return os.path.join(self.directory, digest + _SUFFIX)
+
+    def journal_path(self, digest):
+        return os.path.join(self.directory, digest + ".trials.jsonl")
+
+    # -- write ----------------------------------------------------------------
+    def put(self, record: TuningRecord, fingerprint=None):
+        """Atomically write one record; returns the entry path.
+        ``fingerprint`` is overridable for tests only."""
+        from ..compile import key as key_mod
+        from .. import faultinject
+        os.makedirs(self.directory, exist_ok=True)
+        payload = json.dumps(record.data, sort_keys=True).encode("utf-8")
+        header = {
+            "version": key_mod.FORMAT_VERSION,
+            "digest": record.digest,
+            "name": record.name,
+            "kind": "tune",
+            "fingerprint": fingerprint or key_mod.fingerprint(),
+            "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+            "payload_len": len(payload),
+            "created": time.time(),
+        }
+        hdr = json.dumps(header, sort_keys=True).encode("utf-8")
+        path = self.path_for(record.digest)
+        with atomic_write(path) as f:
+            f = faultinject.guarded_write(f, path=path, site="tune_trial")
+            f.write(_MAGIC)
+            f.write(struct.pack(">I", len(hdr)))
+            f.write(hdr)
+            f.write(payload)
+        # post-commit tearing (storage lying below the rename): the CRC
+        # in the header is what must catch it on load. Only a bytes=
+        # spec arms this shape — a trial=-armed commit drill must not
+        # truncate the record a completed search then writes.
+        armed = faultinject.active("tune_trial")
+        if armed is not None and "bytes" in armed:
+            faultinject.maybe_truncate(path, site="tune_trial")
+        return path
+
+    # -- read -----------------------------------------------------------------
+    def read_header(self, path):
+        try:
+            with open(path, "rb") as f:
+                if f.read(len(_MAGIC)) != _MAGIC:
+                    raise TuneRecordError(path, "corrupt", "bad magic")
+                (hlen,) = struct.unpack(">I", f.read(4))
+                if hlen <= 0 or hlen > (1 << 20):
+                    raise TuneRecordError(path, "corrupt",
+                                          "implausible header length")
+                return json.loads(f.read(hlen).decode("utf-8"))
+        except TuneRecordError:
+            raise
+        except (OSError, ValueError, struct.error,
+                UnicodeDecodeError) as e:
+            raise TuneRecordError(path, "corrupt", str(e))
+
+    def get(self, digest):
+        """The validated :class:`TuningRecord` for ``digest``, None when
+        absent; raises :class:`TuneRecordError` on corrupt/stale."""
+        from ..compile import key as key_mod
+        path = self.path_for(digest)
+        try:
+            with open(path, "rb") as f:
+                if f.read(len(_MAGIC)) != _MAGIC:
+                    raise TuneRecordError(path, "corrupt", "bad magic")
+                (hlen,) = struct.unpack(">I", f.read(4))
+                if hlen <= 0 or hlen > (1 << 20):
+                    raise TuneRecordError(path, "corrupt",
+                                          "implausible header length")
+                header = json.loads(f.read(hlen).decode("utf-8"))
+                payload = f.read()
+        except FileNotFoundError:
+            return None
+        except TuneRecordError:
+            raise
+        except (OSError, ValueError, struct.error,
+                UnicodeDecodeError) as e:
+            raise TuneRecordError(path, "corrupt", str(e))
+        if header.get("fingerprint") != key_mod.fingerprint():
+            raise TuneRecordError(
+                path, "stale",
+                f"built by {header.get('fingerprint')!r}, running "
+                f"{key_mod.fingerprint()!r}")
+        if len(payload) != header.get("payload_len") or \
+                (zlib.crc32(payload) & 0xFFFFFFFF) != header.get("crc32"):
+            raise TuneRecordError(
+                path, "corrupt",
+                f"payload CRC/length mismatch ({len(payload)} bytes)")
+        try:
+            return TuningRecord(json.loads(payload.decode("utf-8")))
+        except (ValueError, UnicodeDecodeError) as e:
+            raise TuneRecordError(path, "corrupt", str(e))
+
+    def load(self, digest):
+        """:meth:`get` with the fallback contract applied: a corrupt or
+        stale record is rejected with a warning + counter and reported
+        as absent — the caller re-searches and overwrites. A torn write
+        can therefore never be APPLIED, only replaced."""
+        try:
+            return self.get(digest)
+        except TuneRecordError as e:
+            from . import _note
+            _note("records_rejected")
+            _log.warning("%s", e)
+            return None
+
+    # -- maintenance ----------------------------------------------------------
+    def entries(self):
+        """[(path, header-or-TuneRecordError)], newest first."""
+        if not os.path.isdir(self.directory):
+            return []
+        out = []
+        for name in os.listdir(self.directory):
+            if not name.endswith(_SUFFIX):
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                out.append((path, self.read_header(path)))
+            except TuneRecordError as e:
+                out.append((path, e))
+        out.sort(key=lambda pe: -os.path.getmtime(pe[0]))
+        return out
+
+    def verify(self):
+        """Fully validate every record (header + fingerprint + CRC).
+        Returns (ok_count, [(path, reason), ...])."""
+        ok, bad = 0, []
+        for path, header in self.entries():
+            if isinstance(header, TuneRecordError):
+                bad.append((path, header.reason))
+                continue
+            try:
+                self.get(header["digest"])
+                ok += 1
+            except TuneRecordError as e:
+                bad.append((path, e.reason))
+        return ok, bad
+
+
+class TrialJournal:
+    """Append-only completed-trial log for one search (see module
+    docstring). Each line is ``{"crc": crc32(entry-json), "e": entry}``
+    — self-validating, so a torn tail line is detected and skipped,
+    never half-replayed."""
+
+    def __init__(self, path):
+        self.path = os.fspath(path)
+
+    def append(self, entry: dict):
+        blob = json.dumps(entry, sort_keys=True)
+        line = json.dumps(
+            {"crc": zlib.crc32(blob.encode("utf-8")) & 0xFFFFFFFF,
+             "e": entry}, sort_keys=True)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(self.path, "a") as f:
+            f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def load(self):
+        """Every valid completed-trial entry, in append order; invalid
+        or torn lines are counted and skipped."""
+        if not os.path.exists(self.path):
+            return []
+        out = []
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                    blob = json.dumps(rec["e"], sort_keys=True)
+                    if (zlib.crc32(blob.encode("utf-8")) & 0xFFFFFFFF) \
+                            != rec["crc"]:
+                        raise ValueError("trial line CRC mismatch")
+                    out.append(rec["e"])
+                except (ValueError, KeyError, TypeError):
+                    from . import _note
+                    _note("journal_lines_rejected")
+                    _log.warning(
+                        "tune trial journal %s: skipping torn/invalid "
+                        "line", os.path.basename(self.path))
+        return out
+
+    def remove(self):
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+def default_store():
+    """The env-configured store, or None when disabled: MXTPU_TUNE_DIR
+    when set, else ``<MXTPU_COMPILE_CACHE_DIR>/tune`` (tuning records
+    live beside the compiled programs they select); MXTPU_TUNE_CACHE=0
+    switches persistence off entirely."""
+    from .. import config
+    if str(config.get("MXTPU_TUNE_CACHE")).lower() in ("0", "false",
+                                                       "off"):
+        return None
+    directory = str(config.get("MXTPU_TUNE_DIR") or "")
+    if not directory:
+        cache_dir = str(config.get("MXTPU_COMPILE_CACHE_DIR") or "")
+        if not cache_dir:
+            return None
+        directory = os.path.join(cache_dir, "tune")
+    return TuneStore(directory)

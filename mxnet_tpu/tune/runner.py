@@ -1,0 +1,250 @@
+"""The trial runner: deterministic measured search over a SearchSpace.
+
+One search = one pass over the space's deterministic trial sequence
+(``SearchSpace.configs(seed)``), in three stages:
+
+1. **Static pruning** — the workload's ``static(cfg)`` hook judges a
+   configuration from compile-time analysis alone (XLA cost-analysis
+   bytes, ``memory_analysis()`` peak HBM vs. the headroom budget) and
+   returns a prune reason or None. Pruned configs are recorded (status
+   ``pruned``) and never measured — the cheap gate in front of the
+   expensive one.
+2. **Measured trials** — ``measure(cfg, budget)`` returns the objective
+   (lower is better; a dict return carries extra metrics under the
+   ``"objective"`` key). Env-kind knobs are applied around the call via
+   ``config.override`` and the pass manager's measurement memo is
+   scoped per trial (``measure_memo_scope``) so no trial ever reuses a
+   measurement taken under another flag regime. A failing trial
+   (``MXTPU_PALLAS_TILES`` rejecting a bad tile, an OOM'd compile) is
+   recorded ``failed`` and the search continues — a bad configuration
+   fails the TRIAL, never the process.
+3. **Successive halving** — above ``halving_threshold`` surviving
+   configs, trials run in rungs: everyone is measured at a small
+   budget, the best ``1/eta`` graduate to an ``eta``-times larger
+   budget, until the survivors fit one exhaustive final rung. Small
+   spaces skip straight to exhaustive full-budget measurement.
+
+Crash safety: each completed trial is committed to the
+:class:`~.record.TrialJournal` as it finishes; the ``tune_trial``
+faultinject site is consulted at that commit boundary (``trial=N``,
+``action=kill`` is the SIGKILL-mid-search drill). A resumed search
+replays journaled results (status ``reused``) instead of re-measuring.
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Callable, Dict, List, Optional
+
+from .. import config as _config
+from .. import faultinject
+from ..symbol.passes.manager import measure_memo_scope
+from .space import SearchSpace
+
+__all__ = ["Trial", "TrialRunner"]
+
+
+class Trial:
+    """One configuration's outcome within a search."""
+
+    __slots__ = ("config", "config_id", "status", "objective", "budget",
+                 "reason", "metrics", "wall_s")
+
+    def __init__(self, config, config_id, status="pending",
+                 objective=None, budget=0, reason=None, metrics=None,
+                 wall_s=0.0):
+        self.config = dict(config)
+        self.config_id = config_id
+        self.status = status          # pruned | measured | reused | failed
+        self.objective = objective
+        self.budget = budget
+        self.reason = reason
+        self.metrics = dict(metrics or {})
+        self.wall_s = wall_s
+
+    def to_entry(self) -> dict:
+        """The journal/report serialization."""
+        return {"config": self.config, "config_id": self.config_id,
+                "status": self.status, "objective": self.objective,
+                "budget": self.budget, "reason": self.reason,
+                "metrics": self.metrics, "wall_s": self.wall_s}
+
+    @classmethod
+    def from_entry(cls, e: dict) -> "Trial":
+        return cls(e["config"], e["config_id"], e.get("status", "?"),
+                   e.get("objective"), e.get("budget", 0),
+                   e.get("reason"), e.get("metrics"),
+                   e.get("wall_s", 0.0))
+
+    def __repr__(self):
+        return (f"Trial({self.config_id}, {self.status}, "
+                f"objective={self.objective})")
+
+
+class TrialRunner:
+    """See module docstring.
+
+    ``measure(cfg, budget)`` -> objective float (or dict with an
+    ``"objective"`` key); ``static(cfg)`` -> prune-reason string or
+    None. ``budget`` starts at ``base_budget`` repeats/steps and grows
+    by ``eta`` per halving rung up to ``full_budget``.
+    """
+
+    def __init__(self, space: SearchSpace, measure: Callable, *,
+                 static: Optional[Callable] = None, seed: int = 0,
+                 max_trials: Optional[int] = None, eta: int = 2,
+                 halving_threshold: int = 8, base_budget: int = 1,
+                 full_budget: int = 4,
+                 journal=None, on_trial: Optional[Callable] = None,
+                 name: str = "search"):
+        self.space = space
+        self.measure = measure
+        self.static = static
+        self.seed = int(seed)
+        if max_trials is None:
+            max_trials = int(_config.get("MXTPU_TUNE_MAX_TRIALS", 0))
+        self.max_trials = int(max_trials)
+        self.eta = max(2, int(eta))
+        self.halving_threshold = max(1, int(halving_threshold))
+        self.base_budget = max(1, int(base_budget))
+        self.full_budget = max(self.base_budget, int(full_budget))
+        self.journal = journal
+        self.on_trial = on_trial
+        self.name = name
+        self.trials: List[Trial] = []
+        self._ordinal = 0           # tune_trial site coordinate
+
+    # -- one measured trial ---------------------------------------------------
+    def _applied(self, cfg):
+        """Env-kind knobs as a stack of config.override scopes."""
+        import contextlib
+        stack = contextlib.ExitStack()
+        for name, value in self.space.env_items(cfg):
+            stack.enter_context(_config.override(
+                name, None if value in (None, "") else value))
+        return stack
+
+    def _run_one(self, trial: Trial, budget: int):
+        from . import _note
+        t0 = time.time()
+        try:
+            with self._applied(trial.config), measure_memo_scope():
+                out = self.measure(trial.config, budget)
+            if isinstance(out, dict):
+                trial.metrics = {k: v for k, v in out.items()
+                                 if k != "objective"}
+                out = out["objective"]
+            trial.objective = float(out)
+            trial.status = "measured"
+            trial.budget = budget
+            _note("trials_run")
+        except Exception as e:
+            trial.status = "failed"
+            trial.reason = repr(e)
+            trial.objective = None
+            _note("trials_failed")
+        trial.wall_s = time.time() - t0
+        self._commit(trial)
+
+    def _commit(self, trial: Trial):
+        """The per-trial durability boundary: consult the tune_trial
+        fault site (the kill-mid-search drill lands here, between a
+        finished measurement and its journal line), then journal."""
+        self._ordinal += 1
+        params = faultinject.active("tune_trial")
+        if params is not None and "trial" in params and \
+                faultinject.fire("tune_trial", trial=self._ordinal):
+            # byte=/bytes= arm the record WRITE (record.py), not this
+            # boundary — only a trial= coordinate belongs to the commit
+            raise faultinject.FaultInjected("tune_trial",
+                                            trial=self._ordinal)
+        if self.journal is not None:
+            self.journal.append(trial.to_entry())
+        if self.on_trial is not None:
+            self.on_trial(trial)
+
+    # -- the search loop ------------------------------------------------------
+    def search(self):
+        """Run the search; returns (best measured Trial or None, all
+        trials). Deterministic for a fixed (space, seed, journal
+        state)."""
+        from . import _note
+        t0 = time.time()
+        configs = self.space.configs(self.seed, self.max_trials)
+        done: Dict[str, dict] = {}
+        if self.journal is not None:
+            for e in self.journal.load():
+                done[e["config_id"]] = e
+
+        candidates: List[Trial] = []
+        for cfg in configs:
+            cid = self.space.config_id(cfg)
+            trial = Trial(cfg, cid)
+            self.trials.append(trial)
+            prev = done.get(cid)
+            if prev is not None and prev.get("status") in ("measured",
+                                                           "pruned",
+                                                           "failed"):
+                # resume: replay the journaled outcome, never re-measure
+                trial.status = "reused"
+                trial.objective = prev.get("objective")
+                trial.budget = prev.get("budget", 0)
+                trial.reason = prev.get("reason")
+                trial.metrics = dict(prev.get("metrics") or {})
+                _note("trials_reused")
+                if self.on_trial is not None:
+                    self.on_trial(trial)
+                if prev.get("status") == "measured":
+                    candidates.append(trial)
+                continue
+            if self.static is not None:
+                try:
+                    with self._applied(cfg), measure_memo_scope():
+                        reason = self.static(cfg)
+                except Exception as e:
+                    reason = f"static analysis failed: {e!r}"
+                if reason:
+                    trial.status = "pruned"
+                    trial.reason = str(reason)
+                    _note("trials_pruned")
+                    self._commit(trial)
+                    continue
+            candidates.append(trial)
+
+        pending = [t for t in candidates if t.status == "pending"]
+        if len(pending) > self.halving_threshold:
+            self._halving(pending)
+        else:
+            for t in pending:
+                self._run_one(t, self.full_budget)
+
+        measured = [t for t in self.trials
+                    if t.status in ("measured", "reused")
+                    and t.objective is not None]
+        best = min(measured, key=lambda t: t.objective, default=None)
+        from ..telemetry import registry as _treg
+        _treg.gauge(f"tune::{self.name}::search_wall_s").set(
+            time.time() - t0)
+        return best, self.trials
+
+    def _halving(self, pending: List[Trial]):
+        """Successive halving: measure every survivor at the rung's
+        budget, keep the best ceil(n/eta) for the next, eta-times
+        larger, budget; reused trials keep their journaled objective
+        and compete without re-measuring."""
+        budget = self.base_budget
+        rung = pending
+        while len(rung) > self.halving_threshold and \
+                budget < self.full_budget:
+            for t in rung:
+                if t.status == "pending":
+                    self._run_one(t, budget)
+            alive = sorted(
+                (t for t in rung if t.objective is not None),
+                key=lambda t: t.objective)
+            rung = alive[:max(1, math.ceil(len(alive) / self.eta))]
+            budget = min(self.full_budget, budget * self.eta)
+        for t in rung:
+            if t.status == "pending" or (t.status == "measured"
+                                         and t.budget < self.full_budget):
+                self._run_one(t, self.full_budget)

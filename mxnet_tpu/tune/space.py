@@ -1,0 +1,200 @@
+"""Declarative search spaces: the tunable-knob half of the autotuner.
+
+A :class:`SearchSpace` is an ordered set of :class:`Knob` definitions,
+each with a typed, finite domain and an explicit default — the
+configuration a process runs when nobody tuned it, and the baseline
+every tuned result is measured against. Knobs come in two kinds:
+
+- ``env`` knobs name an ``MXTPU_*`` configuration variable; the trial
+  runner applies them via :func:`mxnet_tpu.config.override` around each
+  trial (the pass-pipeline flags, ``MXTPU_PALLAS_TILES``,
+  ``MXTPU_DATA_WORKERS`` / ``MXTPU_DATA_STAGE_AHEAD``...).
+- ``param`` knobs are plain values the workload's measure function
+  consumes directly (batch size, serving bucket set, ``max_wait_us``).
+
+Spaces are deliberately small and declarative — TVM's lesson (PAPERS.md)
+is that measured search over a *well-chosen* finite space beats
+hand-tuning; the framework's job here is to make enumeration
+deterministic, configurations canonically identifiable (so a killed
+search can resume from its trial journal), and the space itself part of
+the tuning record's cache key (a changed space is a different search,
+never a warm hit).
+"""
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import random
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["Knob", "SearchSpace", "pass_knobs", "tile_knobs",
+           "data_knobs", "serving_knobs", "batch_knob"]
+
+
+class Knob:
+    """One tunable: a name, a finite ordered domain, a default (must be
+    in the domain), and the kind (``env`` applies through the
+    environment, ``param`` feeds the workload's measure fn)."""
+
+    __slots__ = ("name", "values", "default", "kind", "doc")
+
+    def __init__(self, name: str, values: Sequence, default=None,
+                 kind: str = "param", doc: str = ""):
+        values = tuple(values)
+        if not values:
+            raise ValueError(f"knob '{name}' has an empty domain")
+        if kind not in ("env", "param"):
+            raise ValueError(f"knob '{name}': kind must be 'env' or "
+                             f"'param', got {kind!r}")
+        self.name = name
+        self.values = values
+        self.default = values[0] if default is None else default
+        if self.default not in values:
+            raise ValueError(
+                f"knob '{name}': default {self.default!r} not in domain")
+        self.kind = kind
+        self.doc = doc
+
+    def describe(self):
+        return {"name": self.name, "kind": self.kind,
+                "values": list(self.values), "default": self.default}
+
+    def __repr__(self):
+        return (f"Knob({self.name!r}, {self.values!r}, "
+                f"default={self.default!r}, kind={self.kind!r})")
+
+
+class SearchSpace:
+    """An ordered set of knobs; the cartesian product is the trial
+    space. Enumeration order is deterministic (knobs in declared order,
+    values in domain order) so a fixed seed always yields the same
+    trial sequence — the resumability and reproducibility contract."""
+
+    def __init__(self, knobs: Sequence[Knob], name: str = "space"):
+        self.name = name
+        self.knobs = list(knobs)
+        seen = set()
+        for k in self.knobs:
+            if k.name in seen:
+                raise ValueError(f"duplicate knob '{k.name}'")
+            seen.add(k.name)
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for k in self.knobs:
+            n *= len(k.values)
+        return n
+
+    def default_config(self) -> Dict:
+        return {k.name: k.default for k in self.knobs}
+
+    def enumerate(self) -> List[Dict]:
+        """Every configuration, in deterministic declared order."""
+        names = [k.name for k in self.knobs]
+        return [dict(zip(names, combo)) for combo in
+                itertools.product(*(k.values for k in self.knobs))]
+
+    def configs(self, seed: int = 0, max_trials: int = 0) -> List[Dict]:
+        """The trial sequence: full enumeration when the space fits
+        ``max_trials`` (or it is 0 = unbounded), else a seeded sample
+        without replacement. Either way the order is a deterministic
+        function of (space, seed) — and always includes the default
+        configuration, so best-vs-default is measured, not assumed."""
+        all_cfgs = self.enumerate()
+        if max_trials and len(all_cfgs) > max_trials:
+            rng = random.Random(int(seed))
+            all_cfgs = rng.sample(all_cfgs, max_trials)
+        else:
+            rng = random.Random(int(seed))
+            rng.shuffle(all_cfgs)
+        default = self.default_config()
+        if default in all_cfgs:
+            all_cfgs.remove(default)
+        return [default] + all_cfgs
+
+    def config_id(self, cfg: Dict) -> str:
+        """Canonical short id of one configuration — the trial journal's
+        resume key (stable across processes and dict orderings)."""
+        blob = json.dumps(sorted(cfg.items()), sort_keys=True,
+                          default=str).encode("utf-8")
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+    def describe(self) -> dict:
+        """Key material: two spaces differing in any knob name, domain,
+        or default are different searches (no warm-hit sharing)."""
+        return {"name": self.name,
+                "knobs": [k.describe() for k in self.knobs]}
+
+    def env_items(self, cfg: Dict):
+        """[(env var, value)] for the env-kind knobs of ``cfg``."""
+        return [(k.name, cfg[k.name]) for k in self.knobs
+                if k.kind == "env" and k.name in cfg]
+
+    def param_items(self, cfg: Dict) -> Dict:
+        return {k.name: cfg[k.name] for k in self.knobs
+                if k.kind == "param" and k.name in cfg}
+
+    def __repr__(self):
+        return (f"SearchSpace({self.name!r}, {len(self.knobs)} knobs, "
+                f"size={self.size})")
+
+
+# ---------------------------------------------------------------------------
+# prebuilt knob families over the knobs the framework already exposes
+# ---------------------------------------------------------------------------
+_PASS_FLAGS = ("MXTPU_PALLAS_FUSION", "MXTPU_PASS_RESIDUAL_FUSION",
+               "MXTPU_PASS_BN_FOLD", "MXTPU_PASS_BF16")
+
+
+def pass_knobs(flags: Optional[Sequence[str]] = None) -> List[Knob]:
+    """On/off knobs over the r12 pass-pipeline flags. Default "auto" is
+    the untuned posture (on for TPU backends, off elsewhere); the tuner
+    explores forcing each pass on and off — the measured trial, not the
+    backend heuristic, decides."""
+    return [Knob(f, ("auto", "1", "0"), default="auto", kind="env",
+                 doc="pass-pipeline flag") for f in (flags or _PASS_FLAGS)]
+
+
+def tile_knobs(candidates: Sequence[str] = ("", "256,128", "128,128",
+                                            "512,256")) -> List[Knob]:
+    """``MXTPU_PALLAS_TILES`` output-tile override candidates ("" =
+    built-in largest-dividing selection). Candidates must satisfy the
+    knob's own validation (multiples of 8, within the built-in candidate
+    bounds) — an invalid tile fails the TRIAL loudly, never the
+    process."""
+    return [Knob("MXTPU_PALLAS_TILES", tuple(candidates), default="",
+                 kind="env", doc="Pallas output-tile override")]
+
+
+def data_knobs(workers=(2, 1, 4), stage_ahead=(2, 1, 4)) -> List[Knob]:
+    """Data-pipeline shape: decode worker count × device stage-ahead
+    depth (defaults first — they are the registered env defaults)."""
+    return [
+        Knob("MXTPU_DATA_WORKERS", tuple(workers), kind="env",
+             doc="pipeline decode workers"),
+        Knob("MXTPU_DATA_STAGE_AHEAD", tuple(stage_ahead), kind="env",
+             doc="device staging depth"),
+    ]
+
+
+def serving_knobs(bucket_sets: Sequence[str],
+                  waits: Sequence[int]) -> List[Knob]:
+    """Serving frontier knobs: bucket set (comma-separated string, the
+    ``MXTPU_SERVING_BUCKETS`` format) × DynamicBatcher coalescing
+    window."""
+    return [
+        Knob("buckets", tuple(bucket_sets), kind="param",
+             doc="Predictor bucket set"),
+        Knob("max_wait_us", tuple(int(w) for w in waits), kind="param",
+             doc="DynamicBatcher coalescing window"),
+    ]
+
+
+def batch_knob(candidates: Sequence[int], default: Optional[int] = None
+               ) -> Knob:
+    """Train-step batch size, bounded at search time by the workload's
+    static peak-HBM pruning (memory_analysis headroom), not here."""
+    return Knob("batch", tuple(int(c) for c in candidates),
+                default=default, kind="param", doc="train batch size")

@@ -1,0 +1,423 @@
+"""Tunable workloads: what a search measures, keyed like a program.
+
+A :class:`Workload` binds a :class:`~.space.SearchSpace` to a concrete
+measurement — it owns the canonical cache key (built through
+``compile.program_key`` with kind ``"tune"``, so a tuning record is
+keyed by the same material as the compiled programs it selects: symbol
+digest, input shapes, optimizer, mesh, backend identity, plus the
+space and objective), the static-pruning hook, and the ``measure``
+function the trial runner drives.
+
+Three measurement families, all reusing machinery that already exists:
+
+- :class:`TrainStepWorkload` — objective ``step_bytes_per_row``: XLA
+  cost-analysis bytes-accessed of the train-step proxy
+  (``passes.measure_symbol_bytes`` — the same gate currency as r12)
+  after running the pass pipeline under the trial's flag regime,
+  normalized per batch row. Compile-time, deterministic, CPU-proxy
+  friendly. Static pruning bounds the batch knob by peak-HBM headroom
+  (``memory_analysis()`` of the compiled proxy vs.
+  ``MXTPU_TUNE_HBM_BUDGET``).
+- :class:`ServingWorkload` — objective ``p99_ms`` at a fixed
+  closed-loop load (``serving/loadgen.py`` through a DynamicBatcher —
+  the ONE closed-loop measurement implementation, shared with
+  ``tools/serving_bench.py``) over bucket-set × ``max_wait_us`` knobs.
+- :class:`DataPipelineWorkload` — objective ``wall_s_per_batch`` to
+  drain N batches through a ``DataPipeline`` under the trial's
+  ``MXTPU_DATA_WORKERS`` / ``MXTPU_DATA_STAGE_AHEAD``.
+
+``conv_proxy()`` / ``sparse_proxy()`` are the built-in CPU-proxy
+workloads (the conv family's BN→ReLU→1×1-conv tower and the sparse
+family's two-tower embedding+conv recommender) shared by ``bench.py
+tuned_vs_default``, ``tools/tune.py``, and the tier-1 tests.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from .space import SearchSpace, Knob, pass_knobs, batch_knob, \
+    serving_knobs, data_knobs
+
+__all__ = ["Workload", "TrainStepWorkload", "ServingWorkload",
+           "DataPipelineWorkload", "conv_proxy", "sparse_proxy",
+           "builtin_workload", "measure_serving", "BUILTIN_WORKLOADS"]
+
+
+class Workload:
+    """Base: a named, keyed, measurable search target."""
+
+    name = "workload"
+    objective = "objective"
+    builtin: Optional[str] = None    # tools/tune.py rebuild tag
+
+    def __init__(self, space: SearchSpace):
+        self.space = space
+
+    def key(self):
+        """Canonical ProgramKey (kind "tune") — see module docstring."""
+        from ..compile import program_key
+        return program_key("tune", f"tune:{self.name}",
+                           **self.key_material())
+
+    def key_material(self) -> dict:
+        return {"extra": {"space": self.space.describe(),
+                          "objective": self.objective,
+                          "builtin": self.builtin}}
+
+    def static(self, cfg: Dict) -> Optional[str]:
+        """Prune reason from compile-time analysis, or None."""
+        return None
+
+    def measure(self, cfg: Dict, budget: int) -> float:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# train step: bytes-accessed objective over pass flags / tiles / batch
+# ---------------------------------------------------------------------------
+class TrainStepWorkload(Workload):
+    """See module docstring. ``feed_shapes`` are the data/label feed
+    shapes WITHOUT the batch dimension resolved per trial when a
+    ``batch`` knob is present — they are given at the default batch and
+    rescaled along axis 0."""
+
+    objective = "step_bytes_per_row"
+
+    def __init__(self, name, symbol, feed_shapes: Dict[str, tuple],
+                 space: SearchSpace, optimizer=None, mesh=None,
+                 batch_axis: int = 0, hbm_budget: Optional[int] = None):
+        super().__init__(space)
+        self.name = name
+        self.symbol = symbol
+        self.feed_shapes = {n: tuple(s) for n, s in feed_shapes.items()}
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.batch_axis = int(batch_axis)
+        self.hbm_budget = hbm_budget
+        self.default_batch = next(iter(self.feed_shapes.values())
+                                  )[self.batch_axis]
+
+    def key_material(self):
+        from ..compile.key import symbol_digest
+        m = super().key_material()
+        m.update(symbol_sha=symbol_digest(self.symbol),
+                 input_sigs=sorted(self.feed_shapes.items()),
+                 optimizer=self.optimizer, mesh=self.mesh)
+        return m
+
+    # -- shape plumbing -------------------------------------------------------
+    def _shapes(self, cfg) -> Dict[str, tuple]:
+        """Full arg+aux shape map at the trial's batch size."""
+        batch = int(cfg.get("batch", self.default_batch))
+        kw = {}
+        for n, s in self.feed_shapes.items():
+            s = list(s)
+            s[self.batch_axis] = batch
+            kw[n] = tuple(s)
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**kw)
+        shapes = dict(zip(self.symbol.list_arguments(), arg_shapes))
+        shapes.update(zip(self.symbol.list_auxiliary_states(),
+                          aux_shapes))
+        return shapes
+
+    def _pipeline(self, cfg):
+        """The trial's rewritten graph (or the original when no pass
+        fired) under the already-applied env regime."""
+        from ..symbol import passes as P
+        shapes = self._shapes(cfg)
+        final, _rep = P.apply_pipeline(self.symbol, shapes, tag="tune",
+                                       mode="train")
+        return (final if final is not None else self.symbol), shapes
+
+    # -- static pruning: peak-HBM headroom ------------------------------------
+    def _budget_bytes(self):
+        from .. import config as _config
+        if self.hbm_budget is not None:
+            return int(self.hbm_budget)
+        return int(_config.get("MXTPU_TUNE_HBM_BUDGET", 0))
+
+    def static(self, cfg):
+        budget = self._budget_bytes()
+        if not budget or "batch" not in cfg:
+            return None
+        if int(cfg["batch"]) == self.default_batch:
+            return None           # the baseline is never pruned away
+        peak = self.static_peak_bytes(cfg)
+        if peak is not None and peak > budget:
+            return (f"peak HBM {peak} > budget {budget} at "
+                    f"batch={cfg['batch']}")
+        return None
+
+    def static_peak_bytes(self, cfg):
+        """``memory_analysis()`` peak of the compiled train-step proxy
+        at the trial's batch (None when the backend exposes none)."""
+        try:
+            import jax
+            import numpy as np
+            from ..executor import build_graph_fns
+            from ..telemetry import memory as _tmem
+            sym, shapes = self._pipeline(cfg)
+            arg_names = sym.list_arguments()
+            aux_names = sym.list_auxiliary_states()
+            if any(n not in shapes for n in arg_names + aux_names):
+                return None
+
+            def sds(n):
+                return jax.ShapeDtypeStruct(tuple(shapes[n]),
+                                            np.float32)
+
+            fwd, fwd_loss, _ = build_graph_fns(sym)
+
+            def fn(arg_vals, aux_vals, key):
+                return jax.grad(fwd_loss, argnums=0, has_aux=True)(
+                    arg_vals, aux_vals, None, key)
+
+            exe = jax.jit(fn).lower(
+                tuple(sds(n) for n in arg_names),
+                tuple(sds(n) for n in aux_names),
+                jax.random.PRNGKey(0)).compile()
+            mem = _tmem.analyze(exe)
+            return mem.get("peak_bytes") or None
+        except Exception:
+            return None
+
+    # -- the measured objective -----------------------------------------------
+    def measure(self, cfg, budget):
+        from ..base import MXNetError
+        from ..symbol.passes import measure_symbol_bytes
+        sym, shapes = self._pipeline(cfg)
+        by = measure_symbol_bytes(sym, shapes, mode="train")
+        if by is None:
+            raise MXNetError(
+                f"{self.name}: backend exposes no cost analysis — the "
+                "bytes objective cannot be measured")
+        batch = int(cfg.get("batch", self.default_batch))
+        return {"objective": by / batch, "step_bytes": by,
+                "batch": batch}
+
+
+# ---------------------------------------------------------------------------
+# serving: closed-loop p99 over bucket sets × coalescing windows
+# ---------------------------------------------------------------------------
+def measure_serving(predictor, feat, max_wait_us, clients, per_client=8,
+                    timeout=600):
+    """THE closed-loop serving measurement: single-row clients through
+    a DynamicBatcher over ``predictor``, plus the RAW compiled predict
+    rate at the top bucket for the efficiency column. Shared verbatim
+    by :class:`ServingWorkload` and ``tools/serving_bench.py``."""
+    import numpy as np
+    from .. import serving
+    from ..serving import loadgen
+    rng = np.random.RandomState(0)
+    top = predictor.max_batch
+    x_top = rng.rand(top, *feat).astype(np.float32)
+    predictor.warmup()
+    raw_rows_s = loadgen.raw_predict_rate(predictor, x_top, steps=8)
+    with serving.DynamicBatcher(predictor, max_wait_us=max_wait_us,
+                                max_queue=100_000,
+                                name=f"tune{max_wait_us}") as bat:
+        x1 = rng.rand(1, *feat).astype(np.float32)
+        bat.predict(x1)
+        r = loadgen.closed_loop(bat, x1, clients, per_client,
+                                timeout=timeout)
+        rep = bat.report()
+    hot = max(rep["per_bucket"].items(),
+              key=lambda kv: kv[1]["batches"] or 0)
+    return {
+        "objective": r["p99_ms"],
+        "rows_s": r["rows_s"],
+        "p50_ms": r["p50_ms"],
+        "p99_ms": r["p99_ms"],
+        "raw_rows_s": raw_rows_s,
+        "efficiency": r["rows_s"] / raw_rows_s if raw_rows_s else None,
+        "hot_bucket": hot[0],
+        "occupancy": hot[1]["occupancy"],
+        "retraces": predictor.retraces,
+    }
+
+
+class ServingWorkload(Workload):
+    """Bucket-set × ``max_wait_us`` search for a Predictor behind a
+    DynamicBatcher. ``make_predictor(buckets)`` builds the Predictor
+    for one bucket set (the expensive, per-bucket-set half);
+    measurement is :func:`measure_serving` at a fixed closed-loop load.
+    ``budget`` scales the per-client request count."""
+
+    objective = "p99_ms"
+
+    def __init__(self, name, make_predictor, feat,
+                 bucket_sets: Sequence[str], waits: Sequence[int],
+                 space: Optional[SearchSpace] = None,
+                 clients: int = 8, per_client: int = 4,
+                 symbol=None):
+        space = space or SearchSpace(serving_knobs(bucket_sets, waits),
+                                     name=f"{name}-serving")
+        super().__init__(space)
+        self.name = name
+        self.make_predictor = make_predictor
+        self.feat = tuple(feat)
+        self.clients = int(clients)
+        self.per_client = int(per_client)
+        self.symbol = symbol
+        self._cache = {}
+
+    def key_material(self):
+        m = super().key_material()
+        if self.symbol is not None:
+            from ..compile.key import symbol_digest
+            m["symbol_sha"] = symbol_digest(self.symbol)
+        m["input_sigs"] = [("feat", self.feat),
+                           ("clients", self.clients),
+                           ("per_client", self.per_client)]
+        return m
+
+    def _predictor(self, buckets_spec):
+        if buckets_spec not in self._cache:
+            buckets = tuple(int(b) for b in
+                            str(buckets_spec).split(","))
+            self._cache[buckets_spec] = self.make_predictor(buckets)
+        return self._cache[buckets_spec]
+
+    def measure(self, cfg, budget):
+        pred = self._predictor(cfg["buckets"])
+        return measure_serving(pred, self.feat,
+                               int(cfg["max_wait_us"]), self.clients,
+                               per_client=self.per_client * max(1, budget))
+
+
+# ---------------------------------------------------------------------------
+# data pipeline: drain-wall objective over worker/staging knobs
+# ---------------------------------------------------------------------------
+class DataPipelineWorkload(Workload):
+    """``MXTPU_DATA_WORKERS`` × ``MXTPU_DATA_STAGE_AHEAD`` search:
+    objective is the wall per batch to drain ``make_iter()`` through a
+    DataPipeline (budget multiplies the drained-batch count). The env
+    knobs are applied by the runner; the pipeline reads them at
+    construction."""
+
+    objective = "wall_s_per_batch"
+
+    def __init__(self, name, make_iter, batches: int = 16,
+                 space: Optional[SearchSpace] = None,
+                 consume_s: float = 0.0):
+        space = space or SearchSpace(data_knobs(), name=f"{name}-data")
+        super().__init__(space)
+        self.name = name
+        self.make_iter = make_iter
+        self.batches = int(batches)
+        self.consume_s = float(consume_s)
+
+    def key_material(self):
+        m = super().key_material()
+        m["input_sigs"] = [("batches", self.batches),
+                           ("consume_s", self.consume_s)]
+        return m
+
+    def measure(self, cfg, budget):
+        import time as _time
+        from ..data import DataPipeline
+        n = self.batches * max(1, budget)
+        pipe = DataPipeline(self.make_iter())
+        t0 = _time.time()
+        got = 0
+        try:
+            for _ in pipe:
+                got += 1
+                if self.consume_s:
+                    _time.sleep(self.consume_s)
+                if got >= n:
+                    break
+        finally:
+            pipe.close()
+        wall = _time.time() - t0
+        if not got:
+            raise RuntimeError(f"{self.name}: iterator yielded nothing")
+        return {"objective": wall / got, "batches": got,
+                "stats": pipe.stats()}
+
+
+# ---------------------------------------------------------------------------
+# built-in CPU proxies (bench.py tuned_vs_default / tools/tune.py / tests)
+# ---------------------------------------------------------------------------
+def _conv_symbol():
+    """The conv family proxy: a BN→ReLU→1×1-conv tower (the exact
+    subgraph the Pallas fusion pass targets) + classifier — ResNet-50's
+    hot pattern at interactive CPU size."""
+    from .. import symbol as sym
+    data = sym.Variable("data")
+    cur = data
+    for i in range(2):
+        bn = sym.BatchNorm(cur, name=f"bn{i}", fix_gamma=False)
+        act = sym.Activation(bn, act_type="relu", name=f"relu{i}")
+        cur = sym.Convolution(act, kernel=(1, 1), num_filter=16,
+                              no_bias=True, name=f"conv{i}")
+    fc = sym.FullyConnected(sym.Flatten(cur), num_hidden=8, name="fc")
+    return sym.SoftmaxOutput(fc, name="softmax")
+
+
+def _sparse_symbol(vocab=1000, dim=16):
+    """The sparse family proxy: the two-tower recommender shape — an
+    embedding lookup tower concatenated with a conv/BN dense tower (the
+    r13 workload family; lookup-only graphs take the pass manager's
+    ``embedding_graph`` skip, so the dense tower is what the pass knobs
+    act on)."""
+    from .. import symbol as sym
+    img = sym.Variable("img")
+    bn = sym.BatchNorm(img, name="bn1", fix_gamma=False)
+    a = sym.Activation(bn, act_type="relu", name="relu1")
+    conv = sym.Convolution(a, kernel=(1, 1), num_filter=16,
+                           no_bias=True, name="conv1")
+    ids = sym.Variable("ids")
+    emb = sym.Embedding(data=ids, input_dim=vocab, output_dim=dim,
+                        name="emb")
+    cat = sym.Concat(sym.Flatten(conv), sym.Flatten(emb), dim=1)
+    fc = sym.FullyConnected(cat, num_hidden=8, name="fc")
+    return sym.SoftmaxOutput(fc, name="softmax")
+
+
+def conv_proxy(batch: int = 8, batches=(8, 16, 32),
+               hbm_budget: Optional[int] = None) -> TrainStepWorkload:
+    """The conv-family built-in: pass-flag + tile + batch knobs over
+    the BN→ReLU→1×1-conv proxy, bytes-per-row objective."""
+    from .space import tile_knobs
+    knobs = pass_knobs(("MXTPU_PALLAS_FUSION",
+                        "MXTPU_PASS_RESIDUAL_FUSION",
+                        "MXTPU_PASS_BF16")) + tile_knobs() + \
+        [batch_knob(tuple(dict.fromkeys((batch,) + tuple(batches))),
+                    default=batch)]
+    wl = TrainStepWorkload(
+        "conv_small", _conv_symbol(),
+        {"data": (batch, 8, 8, 8), "softmax_label": (batch,)},
+        SearchSpace(knobs, name="conv_small"), hbm_budget=hbm_budget)
+    wl.builtin = "conv"
+    return wl
+
+
+def sparse_proxy(batch: int = 8, batches=(8, 16, 32),
+                 hbm_budget: Optional[int] = None) -> TrainStepWorkload:
+    """The sparse-family built-in: pass-flag + batch knobs over the
+    two-tower embedding+conv proxy, bytes-per-row objective."""
+    knobs = pass_knobs(("MXTPU_PALLAS_FUSION", "MXTPU_PASS_BF16")) + \
+        [batch_knob(tuple(dict.fromkeys((batch,) + tuple(batches))),
+                    default=batch)]
+    wl = TrainStepWorkload(
+        "sparse_two_tower", _sparse_symbol(),
+        {"img": (batch, 8, 4, 4), "ids": (batch, 2),
+         "softmax_label": (batch,)},
+        SearchSpace(knobs, name="sparse_two_tower"),
+        hbm_budget=hbm_budget)
+    wl.builtin = "sparse"
+    return wl
+
+
+BUILTIN_WORKLOADS = {"conv": conv_proxy, "sparse": sparse_proxy}
+
+
+def builtin_workload(name: str, **kwargs) -> Workload:
+    """Rebuild a built-in proxy workload by tag — how ``tools/tune.py
+    verify`` re-measures a stored record's objective."""
+    try:
+        return BUILTIN_WORKLOADS[name](**kwargs)
+    except KeyError:
+        raise KeyError(f"unknown builtin workload {name!r}; known: "
+                       f"{sorted(BUILTIN_WORKLOADS)}")

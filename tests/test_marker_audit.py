@@ -226,6 +226,33 @@ def test_sparse_embedding_suite_stays_tier1_with_chaos_marked():
         "pytest.mark.chaos like the other fault-injection suites")
 
 
+def test_tune_suite_stays_tier1_with_chaos_marked():
+    """The autotune suite is tier-1's only proof that a tuned process
+    boots tuned (zero re-search, zero fresh compiles), that the search
+    finds a strictly-better-than-default config, and that a SIGKILL
+    mid-search can't tear a record. It must (a) exist, (b) never carry
+    a module-wide or per-case ``slow`` mark that would drop those pins
+    from the gate, and (c) mark its kill-mid-search and torn-record
+    drills ``chaos`` so ``-m chaos`` selects the whole fault
+    surface."""
+    path = os.path.join(_TESTS, "test_tune.py")
+    assert os.path.exists(path), "tests/test_tune.py missing"
+    with open(path) as f:
+        src = f.read()
+    m = re.search(r"^pytestmark\s*=.*$", src, re.M)
+    assert m is None or "slow" not in m.group(0), (
+        "test_tune.py must stay tier-1: a module-level slow mark drops "
+        "the warm-boot and tuned-vs-default pins from the gate")
+    uses = _mark_uses()
+    assert "test_tune.py" not in uses.get("slow", set()), (
+        "test_tune.py cases must not be slow-marked — the zero-"
+        "re-search warm boot and strict-improvement pins are round-15 "
+        "acceptance criteria")
+    assert "test_tune.py" in uses.get("chaos", set()), (
+        "the SIGKILL-mid-search and torn-record drills must carry "
+        "pytest.mark.chaos like the other fault-injection suites")
+
+
 def test_trace_memory_suite_stays_tier1_with_chaos_marked():
     """The trace/memory suite is tier-1's only proof that exported
     Chrome traces keep correct request→batch→bucket and step→phase

@@ -12,6 +12,13 @@ the table that picks the bucket set / wait window trade-off for a
 latency SLO (mirrors tools/perf_sweep.py conventions; serving
 internals: mxnet_tpu/serving/).
 
+Since round 15 the sweep drives the autotuner's trial runner
+(``mx.tune.TrialRunner`` over a spec knob, measurement =
+``tune.workloads.measure_serving`` — the ONE closed-loop measurement
+implementation, shared with ``mx.tune.autotune`` of a serving
+workload), so this table and a tuner search can never disagree about
+what a configuration measures.
+
 Off-TPU this runs the same code path compiled for CPU — slower, same
 frontier shape. MXTPU_SERVING_* env vars set the defaults the sweep
 overrides per spec.
@@ -20,8 +27,6 @@ from __future__ import annotations
 
 import os
 import sys
-
-import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(
     os.path.abspath(__file__)), ".."))
@@ -61,35 +66,42 @@ def build_predictor(buckets, batch=64, small=False):
         compute_dtype=None if small else "bfloat16"), feat
 
 
-def measure(pred, feat, max_wait_us, clients, per_client=8):
-    from mxnet_tpu import serving
-    from mxnet_tpu.serving import loadgen
-    rng = np.random.RandomState(0)
-    top = pred.max_batch
-    x_top = rng.rand(top, *feat).astype(np.float32)
-    pred.warmup()
-    raw_img_s = loadgen.raw_predict_rate(pred, x_top, steps=8)
+def parse_spec(spec):
+    """``buckets:max_wait_us[:clients]`` -> (buckets, wait_us, clients)."""
+    parts = spec.split(":")
+    if len(parts) < 2:
+        sys.exit(f"bad spec '{spec}': want buckets:max_wait_us"
+                 "[:clients]")
+    buckets = tuple(int(x) for x in parts[0].split(","))
+    wait_us = int(parts[1])
+    clients = int(parts[2]) if len(parts) > 2 else 64
+    return buckets, wait_us, clients
 
-    with serving.DynamicBatcher(pred, max_wait_us=max_wait_us,
-                                max_queue=100_000,
-                                name=f"sweep{max_wait_us}") as bat:
-        x1 = rng.rand(1, *feat).astype(np.float32)
-        bat.predict(x1)
-        r = loadgen.closed_loop(bat, x1, clients, per_client,
-                                timeout=600)
-        rep = bat.report()
-    hot = max(rep["per_bucket"].items(),
-              key=lambda kv: kv[1]["batches"] or 0)
-    return {
-        "img_s": r["rows_s"],
-        "p50_ms": r["p50_ms"],
-        "p99_ms": r["p99_ms"],
-        "raw_img_s": raw_img_s,
-        "efficiency": r["rows_s"] / raw_img_s,
-        "hot_bucket": hot[0],
-        "occupancy": hot[1]["occupancy"],
-        "retraces": pred.retraces,
-    }
+
+def sweep(specs, small=False, per_client=8, on_trial=None):
+    """Measure every spec through the tuner's trial runner; returns the
+    completed trials in spec order (trial.metrics carries the frontier
+    row, trial.objective is p99 ms)."""
+    from mxnet_tpu import tune
+    from mxnet_tpu.tune.workloads import measure_serving
+
+    def measure(cfg, budget):
+        buckets, wait_us, clients = parse_spec(cfg["spec"])
+        pred, feat = build_predictor(buckets, batch=max(buckets),
+                                     small=small)
+        return measure_serving(pred, feat, wait_us, clients,
+                               per_client=per_client)
+
+    space = tune.SearchSpace(
+        [tune.Knob("spec", tuple(specs), kind="param",
+                   doc="buckets:max_wait_us[:clients]")],
+        name="serving_bench")
+    runner = tune.TrialRunner(space, measure, seed=0, max_trials=0,
+                              base_budget=1, full_budget=1,
+                              on_trial=on_trial, name="serving_bench")
+    runner.search()
+    by_spec = {t.config["spec"]: t for t in runner.trials}
+    return [by_spec[s] for s in specs]
 
 
 def main():
@@ -98,21 +110,20 @@ def main():
     specs = args or ["1,8,64:2000", "1,8,64:500", "1,16,128:2000"]
     print(f"{'spec':>22}  {'img/s':>9}  {'p50 ms':>8}  {'p99 ms':>8}"
           f"  {'eff':>6}  {'bucket':>6}  {'occ':>5}  retraces")
-    for spec in specs:
-        parts = spec.split(":")
-        if len(parts) < 2:
-            sys.exit(f"bad spec '{spec}': want buckets:max_wait_us"
-                     "[:clients]")
-        buckets = tuple(int(x) for x in parts[0].split(","))
-        wait_us = int(parts[1])
-        clients = int(parts[2]) if len(parts) > 2 else 64
-        pred, feat = build_predictor(buckets, batch=max(buckets),
-                                     small=small)
-        r = measure(pred, feat, wait_us, clients)
-        print(f"{spec:>22}  {r['img_s']:9.1f}  {r['p50_ms']:8.2f}"
-              f"  {r['p99_ms']:8.2f}  {r['efficiency']:6.3f}"
-              f"  {r['hot_bucket']:>6}  {r['occupancy'] or 0:5.2f}"
-              f"  {r['retraces']:8d}", flush=True)
+
+    def show(t):
+        if t.status == "failed":
+            print(f"{t.config['spec']:>22}  FAILED: {t.reason}",
+                  flush=True)
+            return
+        m = t.metrics
+        print(f"{t.config['spec']:>22}  {m['rows_s']:9.1f}"
+              f"  {m['p50_ms']:8.2f}"
+              f"  {m['p99_ms']:8.2f}  {m['efficiency']:6.3f}"
+              f"  {m['hot_bucket']:>6}  {m['occupancy'] or 0:5.2f}"
+              f"  {m['retraces']:8d}", flush=True)
+
+    sweep(specs, small=small, on_trial=show)
 
 
 if __name__ == "__main__":
