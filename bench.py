@@ -97,6 +97,67 @@ def tuned_vs_default(max_trials=8, seed=0):
     return out
 
 
+def transformer_serving(clients_list=(1, 8, 64)):
+    """The r16 decode-serving section: a pocket transformer LM behind
+    the continuous batcher (serving/decode/) at 1/8/64 streaming
+    closed-loop clients — tokens/s, TTFT p50/p99, inter-token p99, plus
+    the headline the KV-cache exists for: decode-step bytes-accessed
+    per token vs the re-prefill-per-token baseline (must be < 1)."""
+    import numpy as np
+    from mxnet_tpu.serving import loadgen
+    from mxnet_tpu.serving.decode import (
+        TransformerLMSpec, DecodePredictor, DecodeBatcher, init_params)
+    spec = TransformerLMSpec(vocab_size=256, num_embed=64, num_heads=4,
+                             num_layers=2, max_seq=64, name="benchlm")
+    eng = DecodePredictor(spec, init_params(spec, seed=0), slots=8,
+                          seq_buckets=(16, 32))
+    eng.warmup()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, spec.vocab_size, size=4 + (i * 5) % 16
+                           ).astype(np.int32) for i in range(16)]
+    per_client = {1: 8, 8: 3, 64: 1}
+    client_runs = {}
+    with DecodeBatcher(eng, max_wait_us=2000, max_queue=4096,
+                       name="bench-decode") as bat:
+        for n in clients_list:
+            r = loadgen.token_closed_loop(
+                bat, prompts, n, per_client.get(n, 1),
+                max_new_tokens=16)
+            client_runs[n] = {
+                "tok_s": round(r["tok_s"], 2),
+                "ttft_p50_ms": round(r["ttft_p50_ms"], 3),
+                "ttft_p99_ms": round(r["ttft_p99_ms"], 3),
+                "inter_token_p99_ms": round(
+                    r["inter_token_p99_ms"], 3),
+            }
+        rep = bat.report()
+    decode_tok = eng.decode_bytes_per_token()
+    reprefill_tok = eng.reprefill_bytes_per_token(bucket=32)
+    return {
+        "slots": eng.slots,
+        "seq_buckets": list(eng.buckets),
+        "clients": client_runs,
+        "streamed_tokens": rep["streamed_tokens"],
+        "served_generations": rep["served_generations"],
+        "retraces": eng.retraces,
+        "decode_bytes_per_token": decode_tok,
+        "reprefill_bytes_per_token_b32": reprefill_tok,
+        "decode_vs_reprefill_bytes": round(decode_tok / reprefill_tok,
+                                           4)
+        if decode_tok and reprefill_tok else None,
+        "kv_cache_bytes": eng.kv_cache_bytes(),
+        "note": "streaming closed-loop clients through the continuous "
+                "batcher (serving/decode/): requests join/leave the "
+                "in-flight decode batch per token, freed KV-cache "
+                "lanes backfill mid-flight; "
+                "decode_vs_reprefill_bytes = XLA cost-analysis bytes "
+                "per generated token of the single-token decode "
+                "program (KV-cache, donated) over the cacheless "
+                "re-prefill-the-whole-prompt program at bucket 32 — "
+                "the < 1 ratio is what the KV-cache buys per token",
+    }
+
+
 def main():
     import jax
     import mxnet_tpu as mx
@@ -814,6 +875,13 @@ print("BENCH " + json.dumps({
     except Exception:
         pass
 
+    # -- phase J: autoregressive decode serving (round 16) -------------------
+    transformer_serving_stats = None
+    try:
+        transformer_serving_stats = transformer_serving()
+    except Exception:
+        pass
+
     # -- HBM accounting (round 14): per-program peaks + process peak
     # from the compile registry's recorded memory_analysis — the
     # baseline `tools/telemetry.py diff --gate-peak-mem` compares
@@ -920,6 +988,7 @@ print("BENCH " + json.dumps({
         "cold_start": cold_start,
         "sparse_embedding": sparse_stats,
         "autotune": autotune_stats,
+        "transformer_serving": transformer_serving_stats,
         "memory": memory_stats,
         "telemetry": telemetry_snapshot,
         "host_decode_note": "multiprocess RecordIO->decode->augment->"
@@ -941,5 +1010,10 @@ if __name__ == "__main__":
              "autotune": tuned_vs_default(
                  max_trials=int(sys.argv[2]) if len(sys.argv) > 2
                  else 8)}))
+    elif len(sys.argv) > 1 and sys.argv[1] == "transformer_serving":
+        # standalone fast mode: just the decode-serving section
+        print("BENCH " + json.dumps(
+            {"metric": "transformer_serving",
+             "transformer_serving": transformer_serving()}))
     else:
         main()

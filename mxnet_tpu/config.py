@@ -139,6 +139,21 @@ register("MXTPU_SERVING_MAX_WAIT_US", 2000, int,
 register("MXTPU_SERVING_MAX_QUEUE", 256, int,
          "DynamicBatcher admission bound in queued ROWS; submits past "
          "it fail fast with serving.Overloaded (load shedding)")
+register("MXTPU_DECODE_SLOTS", 4, int,
+         "Decode batch width (serving/decode): number of concurrent "
+         "generation slots in the continuous-batching decode program; "
+         "KV-cache HBM scales linearly with it")
+register("MXTPU_DECODE_SEQ_BUCKETS", "16,64", str,
+         "Prompt-length buckets for the decode prefill program: prompts "
+         "pad to the nearest bucket so arbitrary lengths never retrace "
+         "(clipped to the model's max_seq)")
+register("MXTPU_DECODE_MAX_WAIT_US", 2000, int,
+         "DecodeBatcher first-fill window: when no generation is in "
+         "flight, how long the first queued prompt waits for company "
+         "before prefill launches (joins mid-flight are immediate)")
+register("MXTPU_DECODE_MAX_QUEUE", 256, int,
+         "DecodeBatcher admission bound in queued REQUESTS; submits "
+         "past it fail fast with serving.Overloaded")
 register("MXTPU_CKPT_KEEP", 3, int,
          "CheckpointManager retention: newest K valid checkpoints "
          "survive pruning (checkpoint.py)")

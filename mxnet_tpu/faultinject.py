@@ -4,7 +4,7 @@ Faults are armed **by site and ordinal**, never randomly: a spec names a
 site (``ckpt_write``, ``nan_grad``, ``data_iter``, ``data_worker``,
 ``dist_drop``, ``dist_init``, ``ckpt_truncate``, ``compile_cache``,
 ``telemetry_write``, ``sparse_update``, ``slow_step``,
-``tune_trial``) plus
+``tune_trial``, ``decode_step``) plus
 the exact coordinate at which it fires (byte offset, step index, batch
 index, call ordinal). ``telemetry_write`` is consulted by the durable
 telemetry exporter (telemetry/export.py) on every event append
@@ -32,7 +32,13 @@ SIGKILL-mid-search drill — the trial journal must hold only complete,
 CRC-valid lines and the resumed search must reuse them), while
 ``byte=N`` / ``bytes=N`` arm the TuningRecord write itself
 (mid-write death / post-rename truncation, which the record CRC must
-catch on load). The same spec
+catch on load). ``decode_step`` is consulted in the decode engine
+(serving/decode/engine.py) before each continuous-batching decode
+program launch (``token=N``, the engine-wide step ordinal): a raise
+fails the in-flight generations with the KV-cache un-advanced, and
+``action=kill`` is the SIGKILL-mid-decode drill — a restarted server
+must re-serve the interrupted prompts to bit-identical token streams
+from a clean compile cache. The same spec
 always produces the same failure, so CI chaos suites are reproducible
 bit-for-bit (contrast: the classic chaos-monkey coin flip, useless as a
 regression gate).

@@ -349,6 +349,34 @@ def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False, **kw
     return out
 
 
+# ---------------------------------------------------------------------------
+# CausalSelfAttention — no reference analog (MXNet ~1.1 predates attention);
+# the single-device graduation of parallel/ring.py's blockwise math: one
+# resident block, no ring hop, same stable max/denominator recurrence and
+# the same -1e30 additive-mask convention (masked logits underflow to an
+# exact 0.0 contribution, so padding/stale rows can never perturb outputs).
+# ---------------------------------------------------------------------------
+@register_op("CausalSelfAttention")
+def causal_self_attention(data, num_heads=1, scale=None, **kw):
+    """Causal multi-head self-attention over packed QKV.
+
+    data: (B, S, 3*num_heads*head_dim) — the fused QKV projection
+    (FullyConnected with flatten=False). Returns (B, S, num_heads*head_dim);
+    position i attends to positions <= i.
+    """
+    from ..parallel.ring import local_attention_block, _NEG
+    b, s, three_hd = data.shape
+    h = int(num_heads)
+    d = three_hd // (3 * h)
+    qkv = data.reshape(b, s, 3, h, d)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    pos = jnp.arange(s)
+    bias = jnp.where(pos[:, None] >= pos[None, :], 0.0, _NEG)[None, None]
+    o, _, l = local_attention_block(q, k, v, bias=bias, scale=scale)
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.reshape(b, s, h * d).astype(data.dtype)
+
+
 @register_op("InstanceNorm")
 def instance_norm(data, gamma, beta, eps=1e-3, **kw):
     red = tuple(range(2, data.ndim))

@@ -35,7 +35,7 @@ import weakref
 from ..base import MXNetError
 
 __all__ = ["Predictor", "DynamicBatcher", "ServingError", "Overloaded",
-           "DeadlineExceeded", "serving_report"]
+           "DeadlineExceeded", "Cancelled", "serving_report", "decode"]
 
 
 class ServingError(MXNetError):
@@ -54,6 +54,15 @@ class DeadlineExceeded(ServingError):
     """The request's deadline expired before its micro-batch ran."""
 
 
+class Cancelled(ServingError):
+    """The server stopped while this request was in flight.
+
+    Decode serving introduced *partial* in-flight work (a generation
+    mid-stream when ``stop(drain=False)`` lands): already-streamed
+    tokens stay delivered, the stream then terminates with this error —
+    a future is always completed, never left hanging."""
+
+
 # live Predictor/DynamicBatcher instances; serving_report() walks these.
 # WeakSets so a dropped server never pins device buffers. Every
 # instance gets a stable process-unique id at registration (fleet
@@ -64,8 +73,10 @@ import itertools as _itertools
 
 _PREDICTORS: "weakref.WeakSet" = weakref.WeakSet()
 _BATCHERS: "weakref.WeakSet" = weakref.WeakSet()
+_DECODERS: "weakref.WeakSet" = weakref.WeakSet()
 _PRED_SEQ = _itertools.count()
 _BATCH_SEQ = _itertools.count()
+_DECODE_SEQ = _itertools.count()
 
 
 def _register_predictor(p):
@@ -82,6 +93,17 @@ def _register_predictor(p):
 def _register_batcher(b):
     b.telemetry_id = f"{b.name}#{next(_BATCH_SEQ)}"
     _BATCHERS.add(b)
+
+
+def _register_decoder(d):
+    """DecodePredictor registration (serving/decode/engine.py): same
+    stable-id + registry-cleanup contract as predictors, separate
+    report section — decode programs count tokens and KV-cache bytes,
+    not padded rows."""
+    d.telemetry_id = f"{d.name or 'decode'}#{next(_DECODE_SEQ)}"
+    _DECODERS.add(d)
+    from ..telemetry import registry as treg
+    weakref.finalize(d, treg.remove, f"serving::{d.telemetry_id}::")
 
 
 def _collect(reset: bool = False) -> dict:
@@ -102,6 +124,9 @@ def _collect(reset: bool = False) -> dict:
         "batchers": sorted(
             (b.report(reset=reset) for b in list(_BATCHERS)),
             key=lambda r: r["id"]),
+        "decoders": sorted(
+            (d.report(reset=reset) for d in list(_DECODERS)),
+            key=lambda r: r["id"]),
     }
     if reset:
         _treg.reset(prefix="serving::")
@@ -116,3 +141,4 @@ serving_report = _treg.collector_view("serving", _collect)
 from .predictor import Predictor           # noqa: E402
 from .batcher import DynamicBatcher        # noqa: E402
 from . import loadgen                      # noqa: E402
+from . import decode                       # noqa: E402

@@ -189,6 +189,7 @@ class DynamicBatcher:
                         "server shutting down"))
                 self._queue.clear()
                 self._queued_rows = 0
+                self._cancel_inflight()
             self._running = False
             self._cond.notify_all()
         t = self._thread
@@ -204,6 +205,19 @@ class DynamicBatcher:
             # flush the serving spans now that the loop is quiet —
             # export never sits on a request path
             _trace.export_trace()
+
+    def _cancel_inflight(self):
+        """Hook for ``stop(drain=False)``, called under the queue lock.
+
+        This batcher's unit of work is a WHOLE request: the loop's
+        current micro-batch always runs to completion, so there is no
+        partial in-flight state to cancel. Continuous-batching
+        subclasses (serving/decode/batcher.py) hold generations that
+        are mid-stream for many loop iterations — they override this to
+        mark those for a clean ``Cancelled`` completion instead of
+        draining them for up to ``max_new_tokens`` more steps. Either
+        way a submitted future is ALWAYS completed, never left hanging.
+        """
 
     def __enter__(self):
         return self.start()

@@ -1,0 +1,33 @@
+"""Autoregressive decode serving: KV-cache programs + continuous batching.
+
+The dynamic batcher (serving/batcher.py) coalesces WHOLE requests; an
+autoregressive LM produces one token per program step and would pay a
+full-prompt recompute for every one of them. This package serves
+generation instead:
+
+- ``model`` — a causal transformer LM as symbols/pure functions:
+  ``build_symbol`` (trainable graph for Module.fit), ``prefill_step``
+  (fills a lane of the KV-cache from a prompt), ``decode_step`` (one
+  token for EVERY active lane against the cache), ``reprefill_step``
+  (the cacheless baseline the bytes-accessed gate measures against).
+- ``engine.DecodePredictor`` — two program kinds per model in the
+  compile registry: per-bucket prefill + ONE single-token decode whose
+  KV-cache is donated device state. Cache layout, slot count and
+  ``max_seq`` are compile-key material; the cache itself is a
+  ``decode_state`` row in ``memory_report()``.
+- ``batcher.DecodeBatcher`` — continuous batching: requests join and
+  leave the in-flight decode batch per TOKEN, freed lanes backfill
+  mid-flight, and ``submit()`` returns a :class:`StreamFuture` that
+  streams tokens as they decode. TTFT and inter-token latency feed
+  ``serving::<pid>::ttft_ms`` / ``::inter_token_ms`` histograms.
+
+Config: ``MXTPU_DECODE_SLOTS``, ``MXTPU_DECODE_SEQ_BUCKETS``,
+``MXTPU_DECODE_MAX_WAIT_US``, ``MXTPU_DECODE_MAX_QUEUE``.
+"""
+from . import model
+from .model import TransformerLMSpec, build_symbol, init_params
+from .engine import DecodePredictor
+from .batcher import DecodeBatcher, StreamFuture
+
+__all__ = ["model", "TransformerLMSpec", "build_symbol", "init_params",
+           "DecodePredictor", "DecodeBatcher", "StreamFuture"]

@@ -1,0 +1,534 @@
+"""Continuous batching: requests join/leave the decode batch per TOKEN.
+
+The DynamicBatcher's unit of scheduling is a whole request; for
+iterative decode that wastes lanes — a 4-token generation admitted next
+to a 40-token one would hold its slot for 36 idle steps. Here the
+batcher generalizes to per-token granularity (the vLLM-style continuous
+batching discipline): every loop iteration advances ALL in-flight
+generations one token through the engine's single decode program, and
+any slot freed by a finished generation is backfilled from the queue
+MID-FLIGHT — a prefill for the newcomer, then it rides the next decode
+step with everyone else.
+
+The existing machinery generalizes rather than forks (this class IS a
+DynamicBatcher): admission control sheds past ``max_queue`` queued
+requests with ``Overloaded``; deadlines bound QUEUE time (a generation
+that started always streams to completion); every request carries a
+trace id through its prefill span, token pushes, and completion event;
+``max_wait_us`` becomes the FIRST-FILL window — when nothing is in
+flight, the first queued prompt lingers for company so a cold burst
+prefills together, while joins next to running generations are
+immediate (lingering would stall live streams).
+
+Streaming: ``submit`` returns a :class:`StreamFuture` — iterate it for
+tokens as they decode; ``result()`` blocks for the whole stream.
+``stop(drain=True)`` runs every in-flight generation to completion;
+``stop(drain=False)`` completes them with ``serving.Cancelled`` after
+the tokens already streamed (the satellite fix: a future is ALWAYS
+completed — the loop's finally block guarantees it even on a crashed
+loop). SLO metrics are per-token: ``serving::<pid>::ttft_ms`` and
+``::inter_token_ms`` histograms feed ``serving_report()`` and the
+loadgen token closed loop.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ... import config
+from ...base import MXNetError
+from ...telemetry import trace as _trace
+from .. import Cancelled, DeadlineExceeded, Overloaded
+from ..batcher import DynamicBatcher, _DEADLINE_SLACK_S
+
+__all__ = ["DecodeBatcher", "StreamFuture"]
+
+
+class StreamFuture:
+    """Completion handle for one generation that STREAMS.
+
+    Iterate to receive tokens as they decode::
+
+        for tok in batcher.submit(prompt):
+            ...
+
+    ``result(timeout)`` blocks for the full token list. A failed or
+    cancelled generation delivers its already-streamed tokens, then the
+    iterator (and ``result``) raises the error — ``Cancelled`` on
+    ``stop(drain=False)``, never a hang."""
+
+    __slots__ = ("_cond", "_tokens", "_done", "_error", "trace_id")
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._tokens = []
+        self._done = False
+        self._error = None
+        self.trace_id = None
+
+    # producer side (batcher loop)
+    def _push(self, tok):
+        with self._cond:
+            self._tokens.append(tok)
+            self._cond.notify_all()
+
+    def _finish(self, error=None):
+        with self._cond:
+            if self._done:
+                return
+            self._done = True
+            self._error = error
+            self._cond.notify_all()
+
+    def _complete(self, result=None, error=None):
+        """Base-class completion contract (DynamicBatcher.stop shedding
+        paths call this on queued futures)."""
+        self._finish(error=error)
+
+    # consumer side
+    def done(self):
+        with self._cond:
+            return self._done
+
+    def tokens_so_far(self):
+        with self._cond:
+            return list(self._tokens)
+
+    def __iter__(self):
+        idx = 0
+        while True:
+            with self._cond:
+                while len(self._tokens) <= idx and not self._done:
+                    self._cond.wait(0.1)
+                if len(self._tokens) > idx:
+                    tok = self._tokens[idx]
+                    idx += 1
+                else:
+                    if self._error is not None:
+                        raise self._error
+                    return
+            yield tok
+
+    def result(self, timeout=None):
+        deadline = time.perf_counter() + timeout \
+            if timeout is not None else None
+        with self._cond:
+            while not self._done:
+                remaining = None if deadline is None \
+                    else deadline - time.perf_counter()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError("generation still streaming")
+                self._cond.wait(remaining if remaining is not None
+                                else 0.1)
+            if self._error is not None:
+                raise self._error
+            return list(self._tokens)
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_new_tokens", "stop_token", "future",
+                 "deadline", "t_submit", "trace_id", "span_id", "rows")
+
+    def __init__(self, prompt, max_new_tokens, stop_token, future,
+                 deadline):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.stop_token = stop_token
+        self.future = future
+        self.deadline = deadline
+        self.rows = 1                      # base-class shed-event contract
+        self.trace_id = future.trace_id = _trace.new_trace_id()
+        self.span_id = _trace.new_span_id()
+        self.t_submit = time.perf_counter()
+
+
+class _Gen:
+    """One in-flight generation: a claimed slot plus stream state."""
+
+    __slots__ = ("req", "slot", "bucket", "last", "produced", "limit",
+                 "t_first", "t_last")
+
+    def __init__(self, req, slot, bucket, limit):
+        self.req = req
+        self.slot = slot
+        self.bucket = bucket
+        self.limit = limit
+        self.last = None
+        self.produced = 0
+        self.t_first = None
+        self.t_last = None
+
+    def finished(self):
+        return self.produced >= self.limit or \
+            (self.req.stop_token is not None and
+             self.last == self.req.stop_token)
+
+
+class DecodeBatcher(DynamicBatcher):
+    """Continuous-batching server over a :class:`DecodePredictor`.
+
+    Parameters
+    ----------
+    predictor : DecodePredictor
+    max_wait_us : int, optional
+        First-fill window (default MXTPU_DECODE_MAX_WAIT_US).
+    max_queue : int, optional
+        Queued-REQUEST bound for admission (default
+        MXTPU_DECODE_MAX_QUEUE).
+    name : str
+    """
+
+    def __init__(self, predictor, max_wait_us=None, max_queue=None,
+                 name="decode"):
+        if max_wait_us is None:
+            max_wait_us = int(config.get("MXTPU_DECODE_MAX_WAIT_US",
+                                         2000))
+        if max_queue is None:
+            max_queue = int(config.get("MXTPU_DECODE_MAX_QUEUE", 256))
+        super().__init__(predictor, max_batch=predictor.slots,
+                         max_wait_us=max_wait_us, max_queue=max_queue,
+                         name=name)
+        self._decode_task = self._domain.new_task(f"{name}::decode")
+        from ...telemetry import registry as treg
+        pid = predictor.telemetry_id
+        self._ttft_hist = treg.histogram(f"serving::{pid}::ttft_ms")
+        self._itl_hist = treg.histogram(
+            f"serving::{pid}::inter_token_ms")
+        self._gens_c = treg.counter(f"serving::{pid}::generations")
+        self._inflight = {}                # slot -> _Gen (under _lock)
+        self._cancel_requested = False
+        self._cancelled = 0
+        self._streamed_tokens = 0
+
+    # -- client surface -------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=None, stop_token=None,
+               deadline_ms=None):
+        """Enqueue one generation; returns a :class:`StreamFuture`.
+
+        ``prompt``: 1-D int token sequence (<= the spec's max_seq).
+        ``max_new_tokens`` counts the whole stream including token #1
+        and is clamped to the cache capacity
+        (``DecodePredictor.gen_limit``); ``stop_token`` ends the stream
+        after being yielded. ``deadline_ms`` bounds QUEUE time only —
+        a generation that started always streams to completion."""
+        prompt = self.predictor.check_prompt(prompt)
+        self.predictor.bucket_for(prompt.shape[0])  # validates length
+        future = StreamFuture()
+        deadline = time.perf_counter() + deadline_ms / 1e3 \
+            if deadline_ms is not None else None
+        req = _GenRequest(prompt, max_new_tokens, stop_token, future,
+                          deadline)
+        with self._cond:
+            if not self._running:
+                raise MXNetError(
+                    f"DecodeBatcher '{self.name}' is not started")
+            if self._queued_rows + 1 > self.max_queue:
+                self._shed += 1
+                shed_depth = self._queued_rows
+            else:
+                shed_depth = None
+                self._queue.append(req)
+                self._queued_rows += 1
+                self._cond.notify_all()
+        if shed_depth is not None:
+            self._shed_event(req, shed_depth)
+            raise Overloaded(
+                f"decode queue at bound ({shed_depth} requests queued, "
+                f"max_queue={self.max_queue}); shedding load — retry "
+                "with backoff")
+        return future
+
+    def generate(self, prompt, max_new_tokens=None, stop_token=None,
+                 deadline_ms=None):
+        """Streaming convenience: submit and iterate tokens."""
+        return iter(self.submit(prompt, max_new_tokens=max_new_tokens,
+                                stop_token=stop_token,
+                                deadline_ms=deadline_ms))
+
+    # -- stop() contract ------------------------------------------------------
+    def _cancel_inflight(self):
+        # called under the queue lock by stop(drain=False): mark the
+        # in-flight generations; the LOOP completes their futures with
+        # Cancelled (completing here would race the decode step that is
+        # about to push tokens into them)
+        self._cancel_requested = True
+        self._cond.notify_all()
+
+    # -- the continuous-batching loop ----------------------------------------
+    def _take_cancelled(self):
+        with self._cond:
+            if not self._cancel_requested:
+                return None
+            self._cancel_requested = False
+            victims = list(self._inflight.values())
+            self._inflight.clear()
+        return victims
+
+    def _poll(self):
+        """Admission decisions under the queue lock. Returns
+        ``(admitted, expired)`` — ``admitted`` as ``(req, slot)`` pairs
+        with lanes pre-claimed — or ``None`` at clean exit."""
+        max_wait_s = self.max_wait_us / 1e6
+        with self._cond:
+            while self._running and not self._queue and \
+                    not self._inflight and not self._cancel_requested:
+                self._cond.wait(timeout=0.1)
+            if self._cancel_requested:
+                return [], []
+            if not self._queue and not self._inflight:
+                return None                         # stopped + drained
+            if self._queue and not self._inflight and self._running:
+                # first-fill linger: a cold burst is worth batching the
+                # prefills; deadlines cap the linger exactly like the
+                # whole-request batcher's window
+                t_first = self._queue[0].t_submit
+                while self._running and \
+                        len(self._queue) < self.predictor.slots:
+                    launch_at = t_first + max_wait_s
+                    for r in self._queue:
+                        if r.deadline is not None and \
+                                r.deadline - _DEADLINE_SLACK_S \
+                                < launch_at:
+                            launch_at = r.deadline - _DEADLINE_SLACK_S
+                    remaining = launch_at - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+            admitted, expired = [], []
+            now = time.perf_counter()
+            while self._queue:
+                r = self._queue[0]
+                if r.deadline is not None and r.deadline < now:
+                    self._queue.pop(0)
+                    self._queued_rows -= 1
+                    self._deadline_missed += 1
+                    waited_ms = (now - r.t_submit) * 1e3
+                    r.future._finish(error=DeadlineExceeded(
+                        f"deadline expired after {waited_ms:.1f} ms "
+                        "in queue"))
+                    expired.append((r, waited_ms))
+                    continue
+                slot = self.predictor.alloc_slot()
+                if slot is None:
+                    break                            # lanes saturated
+                self._queue.pop(0)
+                self._queued_rows -= 1
+                admitted.append((r, slot))
+        return admitted, expired
+
+    def _emit_expired(self, expired):
+        from ...telemetry import export as _texp
+        for r, waited_ms in expired:
+            if _texp.enabled():
+                _texp.emit_event(
+                    "serving_deadline", batcher=self.telemetry_id,
+                    predictor=self.predictor.telemetry_id,
+                    trace_id=r.trace_id, rows=1,
+                    waited_ms=round(waited_ms, 3))
+            if _trace.enabled():
+                _trace.record_span(
+                    "serving:request", "serving", r.t_submit,
+                    waited_ms / 1e3, trace_id=r.trace_id,
+                    span_id=r.span_id,
+                    args={"error": "DeadlineExceeded"})
+
+    def _start_gen(self, req, slot):
+        """Prefill a newly admitted request into its lane (outside the
+        queue lock — a compile/program run must never block submit) and
+        stream token #1."""
+        plen = req.prompt.shape[0]
+        bucket = self.predictor.bucket_for(plen)
+        limit = self.predictor.gen_limit(plen, req.max_new_tokens)
+        try:
+            with _trace.span(
+                    "decode:prefill", cat="serving", trace=req.trace_id,
+                    args={"batcher": self.telemetry_id,
+                          "bucket": bucket, "prompt_len": plen}), \
+                    self._tasks[bucket]:
+                tok = self.predictor.prefill(slot, req.prompt)
+        except Exception as e:                       # noqa: BLE001
+            self.predictor.release(slot)
+            req.future._finish(error=e)
+            return
+        now = time.perf_counter()
+        self._ttft_hist.observe((now - req.t_submit) * 1e3)
+        g = _Gen(req, slot, bucket, limit)
+        g.last = tok
+        g.produced = 1
+        g.t_first = g.t_last = now
+        req.future._push(tok)
+        with self._lock:
+            self._streamed_tokens += 1
+        if g.finished():
+            self._complete_gen(g)
+        else:
+            with self._lock:
+                self._inflight[slot] = g
+
+    def _step(self):
+        """Advance every in-flight generation ONE token; retire finished
+        lanes (their slots backfill on the next poll). A failed decode
+        program fails the generations that were in it — the serving
+        loop itself survives."""
+        with self._lock:
+            active = dict(self._inflight)
+        if not active:
+            return
+        mapping = {slot: g.last for slot, g in active.items()}
+        try:
+            with _trace.span(
+                    "decode:step", cat="serving",
+                    args={"batcher": self.telemetry_id,
+                          "lanes": len(mapping),
+                          "trace_ids": [g.req.trace_id
+                                        for g in active.values()]}), \
+                    self._decode_task:
+                out = self.predictor.decode(mapping)
+        except Exception as e:                       # noqa: BLE001
+            with self._lock:
+                for slot in mapping:
+                    self._inflight.pop(slot, None)
+            for slot, g in active.items():
+                self.predictor.release(slot)
+                g.req.future._finish(error=e)
+            return
+        now = time.perf_counter()
+        finished = []
+        with self._lock:
+            for slot, g in active.items():
+                g.last = out[slot]
+                g.produced += 1
+                self._itl_hist.observe((now - g.t_last) * 1e3)
+                g.t_last = now
+                self._streamed_tokens += 1
+                if g.finished():
+                    self._inflight.pop(slot, None)
+                    finished.append(g)
+        for slot, g in active.items():
+            g.req.future._push(g.last)
+        for g in finished:
+            self._complete_gen(g)
+
+    def _complete_gen(self, g, error=None):
+        self.predictor.release(g.slot)
+        now = time.perf_counter()
+        with self._lock:
+            self._served += 1
+        self._lat_hist[g.bucket].observe((now - g.req.t_submit) * 1e3)
+        self._gens_c.inc()
+        g.req.future._finish(error=error)
+        if _trace.enabled():
+            _trace.record_span(
+                "serving:request", "serving", g.req.t_submit,
+                now - g.req.t_submit, trace_id=g.req.trace_id,
+                span_id=g.req.span_id,
+                args={"tokens": g.produced,
+                      "prompt_len": int(g.req.prompt.shape[0])})
+        from ...telemetry import export as _texp
+        if _texp.enabled():
+            _texp.emit_event(
+                "serving_generation", batcher=self.telemetry_id,
+                predictor=self.predictor.telemetry_id,
+                trace_id=g.req.trace_id, tokens=g.produced,
+                prompt_len=int(g.req.prompt.shape[0]),
+                ttft_ms=round((g.t_first - g.req.t_submit) * 1e3, 3),
+                total_ms=round((now - g.req.t_submit) * 1e3, 3))
+
+    def _loop(self):
+        try:
+            while True:
+                victims = self._take_cancelled()
+                if victims is not None:
+                    for g in victims:
+                        self.predictor.release(g.slot)
+                        with self._lock:
+                            self._cancelled += 1
+                        g.req.future._finish(error=Cancelled(
+                            f"server stopped after {g.produced} of "
+                            f"{g.limit} tokens"))
+                    continue
+                work = self._poll()
+                if work is None:
+                    return
+                admitted, expired = work
+                self._emit_expired(expired)
+                for r, slot in admitted:
+                    self._start_gen(r, slot)
+                self._step()
+        finally:
+            # the never-a-hung-future backstop: whatever the exit path
+            # (clean drain, cancellation, or a crashed loop body),
+            # every remaining future completes
+            with self._cond:
+                victims = list(self._inflight.values())
+                self._inflight.clear()
+                queued = list(self._queue)
+                self._queue.clear()
+                self._queued_rows = 0
+                self._cancel_requested = False
+            for g in victims:
+                self.predictor.release(g.slot)
+                with self._lock:
+                    self._cancelled += 1
+                g.req.future._finish(error=Cancelled(
+                    f"serving loop exited after {g.produced} of "
+                    f"{g.limit} tokens"))
+            for r in queued:
+                r.future._finish(error=Cancelled(
+                    "serving loop exited before this generation "
+                    "started"))
+
+    # -- observability --------------------------------------------------------
+    @property
+    def inflight(self):
+        with self._lock:
+            return len(self._inflight)
+
+    def report(self, reset=False):
+        from ...telemetry import registry as treg
+
+        def _snap(h):
+            return treg.snapshot(reset=reset,
+                                 prefix=h.name).get(h.name, {})
+
+        ttft = _snap(self._ttft_hist)
+        itl = _snap(self._itl_hist)
+        with self._lock:
+            per_bucket = {}
+            for b in self.predictor.buckets:
+                h = self._lat_hist[b]
+                hsnap = treg.snapshot(reset=reset,
+                                      prefix=h.name).get(h.name, {})
+                per_bucket[b] = {"generations": hsnap.get("count", 0),
+                                 "p50_ms": hsnap.get("p50"),
+                                 "p99_ms": hsnap.get("p99")}
+            out = {
+                "id": self.telemetry_id,
+                "name": self.name,
+                "predictor_id": self.predictor.telemetry_id,
+                "slots": self.predictor.slots,
+                "max_wait_us": self.max_wait_us,
+                "max_queue": self.max_queue,
+                "queue_depth": self._queued_rows,
+                "inflight": len(self._inflight),
+                "served_generations": self._served,
+                "streamed_tokens": self._streamed_tokens,
+                "cancelled": self._cancelled,
+                "shed_requests": self._shed,
+                "deadline_missed": self._deadline_missed,
+                "retraces": self.predictor.retraces,
+                "ttft_p50_ms": ttft.get("p50"),
+                "ttft_p99_ms": ttft.get("p99"),
+                "inter_token_p50_ms": itl.get("p50"),
+                "inter_token_p99_ms": itl.get("p99"),
+                "per_bucket": per_bucket,
+            }
+            if reset:
+                self._served = 0
+                self._shed = 0
+                self._deadline_missed = 0
+                self._cancelled = 0
+                self._streamed_tokens = 0
+        return out

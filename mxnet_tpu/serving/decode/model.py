@@ -1,0 +1,319 @@
+"""Transformer LM: one weight set, three program shapes.
+
+The same named parameters drive (1) the TRAINING symbol built from
+registry ops (``build_symbol`` — Embedding, LayerNorm, FullyConnected,
+``CausalSelfAttention``, SoftmaxOutput; fits with the ordinary Module
+path), (2) the PREFILL function (process a whole padded prompt, write
+every position's K/V into the cache, emit the first generated token),
+and (3) the single-token DECODE function (one new token per active
+slot against the cached K/V). Prefill and decode are pure jnp — the
+serving engine (engine.py) jits them with the KV-cache as donated
+device state; the symbol is what ``fit()`` trains. Param-name parity is
+the contract: ``Module.get_params()`` output feeds ``DecodePredictor``
+directly (examples/transformer/tiny_lm.py goes end-to-end on it).
+
+KV-cache layout: per layer one K and one V buffer of shape
+``(slots, max_seq, num_heads, head_dim)`` float32 — slot-major so a
+prefill writes one contiguous ``dynamic_update_slice`` row-block and a
+decode step scatters ``slots`` rows at their per-slot positions.
+Inactive slots scatter at index ``max_seq`` with ``mode="drop"``: a
+NONNEGATIVE out-of-bounds sentinel, because negative indices wrap even
+under drop semantics (the r13 sparse-embedding lesson). Stale rows
+beyond a slot's position are masked with the ring-attention ``-1e30``
+convention, whose contribution underflows to an exact 0.0 — stale
+bytes can never perturb the stream, which is what makes continuous
+batching bit-identical to solo decode.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+
+__all__ = ["TransformerLMSpec", "build_symbol", "init_params"]
+
+_NEG = -1e30
+_LN_EPS = 1e-5
+
+
+class TransformerLMSpec:
+    """Static architecture of the decode-servable transformer LM.
+
+    Everything here is compile-key material: two engines with different
+    specs must never share a cached program.
+    """
+
+    def __init__(self, vocab_size, num_embed=64, num_heads=4,
+                 num_layers=2, max_seq=64, ffn_hidden=None, name="lm"):
+        if num_embed % num_heads:
+            raise MXNetError(
+                f"num_embed={num_embed} not divisible by "
+                f"num_heads={num_heads}")
+        self.vocab_size = int(vocab_size)
+        self.num_embed = int(num_embed)
+        self.num_heads = int(num_heads)
+        self.num_layers = int(num_layers)
+        self.max_seq = int(max_seq)
+        self.ffn_hidden = int(ffn_hidden or 4 * num_embed)
+        self.head_dim = self.num_embed // self.num_heads
+        self.name = name
+
+    def param_shapes(self):
+        """Ordered ``{name: shape}`` — the single naming contract shared
+        by the training symbol and the serving programs."""
+        d, f, v = self.num_embed, self.ffn_hidden, self.vocab_size
+        out = {
+            "tok_emb_weight": (v, d),
+            "pos_emb_weight": (self.max_seq, d),
+        }
+        for i in range(self.num_layers):
+            out[f"l{i}_ln1_gamma"] = (d,)
+            out[f"l{i}_ln1_beta"] = (d,)
+            out[f"l{i}_qkv_weight"] = (3 * d, d)
+            out[f"l{i}_proj_weight"] = (d, d)
+            out[f"l{i}_ln2_gamma"] = (d,)
+            out[f"l{i}_ln2_beta"] = (d,)
+            out[f"l{i}_ffn1_weight"] = (f, d)
+            out[f"l{i}_ffn2_weight"] = (d, f)
+        out["lnf_gamma"] = (d,)
+        out["lnf_beta"] = (d,)
+        out["head_weight"] = (v, d)
+        return out
+
+    def param_names(self):
+        return list(self.param_shapes())
+
+    def key_material(self):
+        """Spec fingerprint for ``compile.program_key`` extras."""
+        return {
+            "vocab": self.vocab_size, "embed": self.num_embed,
+            "heads": self.num_heads, "layers": self.num_layers,
+            "max_seq": self.max_seq, "ffn": self.ffn_hidden,
+        }
+
+    def kv_cache_bytes(self, slots):
+        """Accounted KV-cache footprint for ``slots`` generation slots:
+        layers × {K,V} × slots × max_seq × heads × head_dim × f32.
+        Tests pin this against the live buffers' actual nbytes and
+        ``memory_report()`` shows it next to per-program peaks."""
+        return (self.num_layers * 2 * int(slots) * self.max_seq
+                * self.num_heads * self.head_dim * 4)
+
+
+def build_symbol(spec, seq_len, name="softmax"):
+    """Training/scoring symbol at a fixed ``seq_len``: data is a
+    ``(batch, seq_len)`` int token matrix, output the per-position
+    next-token distribution; ``softmax_label`` binds as
+    ``(batch, seq_len)`` shifted targets."""
+    from ... import symbol as sym
+
+    if seq_len > spec.max_seq:
+        raise MXNetError(
+            f"seq_len={seq_len} exceeds spec.max_seq={spec.max_seq}")
+    data = sym.Variable("data")
+    x = sym.Embedding(data=data, weight=sym.Variable("tok_emb_weight"),
+                      input_dim=spec.vocab_size,
+                      output_dim=spec.num_embed, name="tok_emb")
+    pos = sym.Variable("pos_emb_weight",
+                       shape=(spec.max_seq, spec.num_embed))
+    x = sym.broadcast_add(x, pos.slice_axis(0, 0, seq_len),
+                          name="pos_add")
+    for i in range(spec.num_layers):
+        h = sym.LayerNorm(x, gamma=sym.Variable(f"l{i}_ln1_gamma"),
+                          beta=sym.Variable(f"l{i}_ln1_beta"),
+                          axis=-1, eps=_LN_EPS, name=f"l{i}_ln1")
+        qkv = sym.FullyConnected(
+            h, weight=sym.Variable(f"l{i}_qkv_weight"),
+            num_hidden=3 * spec.num_embed, no_bias=True, flatten=False,
+            name=f"l{i}_qkv")
+        attn = sym.CausalSelfAttention(qkv, num_heads=spec.num_heads,
+                                       name=f"l{i}_attn")
+        proj = sym.FullyConnected(
+            attn, weight=sym.Variable(f"l{i}_proj_weight"),
+            num_hidden=spec.num_embed, no_bias=True, flatten=False,
+            name=f"l{i}_proj")
+        x = sym.elemwise_add(x, proj, name=f"l{i}_res1")
+        h2 = sym.LayerNorm(x, gamma=sym.Variable(f"l{i}_ln2_gamma"),
+                           beta=sym.Variable(f"l{i}_ln2_beta"),
+                           axis=-1, eps=_LN_EPS, name=f"l{i}_ln2")
+        f1 = sym.FullyConnected(
+            h2, weight=sym.Variable(f"l{i}_ffn1_weight"),
+            num_hidden=spec.ffn_hidden, no_bias=True, flatten=False,
+            name=f"l{i}_ffn1")
+        f1 = sym.Activation(f1, act_type="relu", name=f"l{i}_relu")
+        f2 = sym.FullyConnected(
+            f1, weight=sym.Variable(f"l{i}_ffn2_weight"),
+            num_hidden=spec.num_embed, no_bias=True, flatten=False,
+            name=f"l{i}_ffn2")
+        x = sym.elemwise_add(x, f2, name=f"l{i}_res2")
+    xf = sym.LayerNorm(x, gamma=sym.Variable("lnf_gamma"),
+                       beta=sym.Variable("lnf_beta"),
+                       axis=-1, eps=_LN_EPS, name="lnf")
+    logits = sym.FullyConnected(
+        xf, weight=sym.Variable("head_weight"),
+        num_hidden=spec.vocab_size, no_bias=True, flatten=False,
+        name="head")
+    return sym.SoftmaxOutput(logits, name=name)
+
+
+def init_params(spec, seed=0, scale=0.02):
+    """Deterministic random parameters (numpy, float32) — serving tests
+    and the chaos worker need a real weight set without a training run;
+    LN affines initialize to identity."""
+    rs = np.random.RandomState(seed)
+    out = {}
+    for n, s in spec.param_shapes().items():
+        if n.endswith("_gamma"):
+            out[n] = np.ones(s, np.float32)
+        elif n.endswith("_beta"):
+            out[n] = np.zeros(s, np.float32)
+        else:
+            out[n] = rs.normal(0.0, scale, s).astype(np.float32)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pure-jnp serving math (jitted by engine.py)
+# ---------------------------------------------------------------------------
+
+def _ln(x, gamma, beta):
+    import jax
+    import jax.numpy as jnp
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + _LN_EPS) * gamma + beta
+
+
+def _split_qkv(qkv, heads, head_dim):
+    """(..., 3*H*D) -> three (..., H, D)."""
+    shp = qkv.shape[:-1] + (3, heads, head_dim)
+    q = qkv.reshape(shp)
+    return q[..., 0, :, :], q[..., 1, :, :], q[..., 2, :, :]
+
+
+def _block_tail(spec, p, i, x, attn_out):
+    """proj + residual + FFN shared by prefill/decode/re-prefill."""
+    import jax.numpy as jnp
+    x = x + attn_out @ p[f"l{i}_proj_weight"].T
+    h2 = _ln(x, p[f"l{i}_ln2_gamma"], p[f"l{i}_ln2_beta"])
+    f = jnp.maximum(h2 @ p[f"l{i}_ffn1_weight"].T, 0.0)
+    return x + f @ p[f"l{i}_ffn2_weight"].T
+
+
+def _head(spec, p, x_last):
+    """Final LN + tied head on the LAST position only — the serving
+    programs never materialize the full (seq, vocab) logit block."""
+    import jax.numpy as jnp
+    xl = _ln(x_last, p["lnf_gamma"], p["lnf_beta"])
+    logits = xl @ p["head_weight"].T
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits
+
+
+def prefill_step(spec, p, caches, tokens, length, slot):
+    """Fill one slot's KV rows from a padded prompt; emit token #1.
+
+    tokens: (1, Sb) int32 padded prompt (Sb = static seq bucket);
+    length: () int32 true prompt length; slot: () int32. caches: tuple
+    of 2*layers buffers (slots, max_seq, H, D). Returns
+    ``(caches', next_token)``. Rows [length, Sb) hold pad K/V — decode
+    overwrites position ``length`` first and masks beyond its position,
+    so they are unreachable (see module docstring).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    sb = tokens.shape[1]
+    scale = 1.0 / (spec.head_dim ** 0.5)
+    x = p["tok_emb_weight"][tokens[0]] + p["pos_emb_weight"][:sb]
+    causal = jnp.arange(sb)[:, None] >= jnp.arange(sb)[None, :]
+    new_caches = []
+    for i in range(spec.num_layers):
+        h = _ln(x, p[f"l{i}_ln1_gamma"], p[f"l{i}_ln1_beta"])
+        qkv = h @ p[f"l{i}_qkv_weight"].T
+        q, k, v = _split_qkv(qkv, spec.num_heads, spec.head_dim)
+        kc = lax.dynamic_update_slice(
+            caches[2 * i], k[None].astype(caches[2 * i].dtype),
+            (slot, 0, 0, 0))
+        vc = lax.dynamic_update_slice(
+            caches[2 * i + 1], v[None].astype(caches[2 * i + 1].dtype),
+            (slot, 0, 0, 0))
+        new_caches += [kc, vc]
+        s = jnp.einsum("qhd,khd->hqk", q, k) * scale
+        s = jnp.where(causal[None], s, _NEG)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        w = jnp.exp(s - m)
+        o = jnp.einsum("hqk,khd->qhd", w, v)
+        o = o / jnp.swapaxes(jnp.sum(w, axis=-1, keepdims=True), 0, 1)
+        x = _block_tail(spec, p, i, x, o.reshape(sb, -1))
+    x_last = x[length - 1]
+    nxt, _ = _head(spec, p, x_last)
+    return tuple(new_caches), nxt
+
+
+def decode_step(spec, p, caches, tokens, positions, active):
+    """Advance every active slot by ONE token against the cache.
+
+    tokens: (slots,) int32 each slot's previous token; positions:
+    (slots,) int32 the position that token occupies (== generated-so-far
+    write index); active: (slots,) bool. Inactive slots compute garbage
+    that writes nowhere (drop-mode scatter at the ``max_seq`` sentinel)
+    and is discarded by the caller. Each slot's lane is independent —
+    batched output rows equal solo output rows bit-for-bit.
+    Returns ``(caches', next_tokens (slots,) int32)``.
+    """
+    import jax.numpy as jnp
+
+    n = tokens.shape[0]
+    scale = 1.0 / (spec.head_dim ** 0.5)
+    sidx = jnp.arange(n)
+    safe_pos = jnp.where(active, positions, 0)
+    wpos = jnp.where(active, positions, spec.max_seq)  # OOB => dropped
+    x = p["tok_emb_weight"][tokens] + p["pos_emb_weight"][safe_pos]
+    visible = jnp.arange(spec.max_seq)[None, :] <= positions[:, None]
+    new_caches = []
+    for i in range(spec.num_layers):
+        h = _ln(x, p[f"l{i}_ln1_gamma"], p[f"l{i}_ln1_beta"])
+        qkv = h @ p[f"l{i}_qkv_weight"].T
+        q, k, v = _split_qkv(qkv, spec.num_heads, spec.head_dim)
+        kc = caches[2 * i].at[sidx, wpos].set(
+            k.astype(caches[2 * i].dtype), mode="drop")
+        vc = caches[2 * i + 1].at[sidx, wpos].set(
+            v.astype(caches[2 * i + 1].dtype), mode="drop")
+        new_caches += [kc, vc]
+        s = jnp.einsum("nhd,nmhd->nhm", q, kc) * scale
+        s = jnp.where(visible[:, None, :], s, _NEG)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        w = jnp.exp(s - m)
+        o = jnp.einsum("nhm,nmhd->nhd", w, vc)
+        o = o / jnp.sum(w, axis=-1)[..., None]
+        x = _block_tail(spec, p, i, x, o.reshape(n, -1))
+    nxt, _ = _head(spec, p, x)
+    return tuple(new_caches), nxt
+
+
+def reprefill_step(spec, p, tokens, length):
+    """The CACHELESS baseline: recompute the whole prompt forward and
+    emit the next token, touching no KV state — what a server without a
+    cache runs per generated token. Exists so the decode-vs-re-prefill
+    bytes-accessed comparison (ISSUE 13's measured gate) compares real
+    compiled programs, not an estimate."""
+    import jax.numpy as jnp
+
+    sb = tokens.shape[1]
+    scale = 1.0 / (spec.head_dim ** 0.5)
+    x = p["tok_emb_weight"][tokens[0]] + p["pos_emb_weight"][:sb]
+    causal = jnp.arange(sb)[:, None] >= jnp.arange(sb)[None, :]
+    for i in range(spec.num_layers):
+        h = _ln(x, p[f"l{i}_ln1_gamma"], p[f"l{i}_ln1_beta"])
+        qkv = h @ p[f"l{i}_qkv_weight"].T
+        q, k, v = _split_qkv(qkv, spec.num_heads, spec.head_dim)
+        s = jnp.einsum("qhd,khd->hqk", q, k) * scale
+        s = jnp.where(causal[None], s, _NEG)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        w = jnp.exp(s - m)
+        o = jnp.einsum("hqk,khd->qhd", w, v)
+        o = o / jnp.swapaxes(jnp.sum(w, axis=-1, keepdims=True), 0, 1)
+        x = _block_tail(spec, p, i, x, o.reshape(sb, -1))
+    x_last = x[length - 1]
+    nxt, _ = _head(spec, p, x_last)
+    return nxt

@@ -15,7 +15,7 @@ import time
 
 import numpy as np
 
-__all__ = ["closed_loop", "raw_predict_rate"]
+__all__ = ["closed_loop", "raw_predict_rate", "token_closed_loop"]
 
 
 def closed_loop(batcher, x_req, clients, per_client, timeout=300):
@@ -52,6 +52,66 @@ def closed_loop(batcher, x_req, clients, per_client, timeout=300):
         "req_s": n_reqs / dt,
         "p50_ms": float(np.percentile(lats, 50)) * 1e3,
         "p99_ms": float(np.percentile(lats, 99)) * 1e3,
+        "wall_s": dt,
+    }
+
+
+def token_closed_loop(batcher, prompts, clients, per_client,
+                      max_new_tokens=8, timeout=300):
+    """Token-granularity twin of :func:`closed_loop` for a
+    ``DecodeBatcher``: each client thread submits a prompt (drawn
+    round-robin from ``prompts``), ITERATES the returned stream, and
+    records time-to-first-token plus every inter-token gap. Returns
+    tokens/s and the two SLO percentile families (TTFT, inter-token)
+    the decode autotuning objective is built from."""
+    ttfts, itls = [], []
+    tokens = [0]
+    lock = threading.Lock()
+    barrier = threading.Barrier(clients + 1)
+
+    def client(cid):
+        barrier.wait()
+        my_ttft, my_itl, my_toks = [], [], 0
+        for i in range(per_client):
+            prompt = prompts[(cid + i * clients) % len(prompts)]
+            t_r = time.perf_counter()
+            t_last = None
+            for _ in batcher.submit(prompt,
+                                    max_new_tokens=max_new_tokens):
+                now = time.perf_counter()
+                if t_last is None:
+                    my_ttft.append(now - t_r)
+                else:
+                    my_itl.append(now - t_last)
+                t_last = now
+                my_toks += 1
+        with lock:
+            ttfts.extend(my_ttft)
+            itls.extend(my_itl)
+            tokens[0] += my_toks
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    deadline = t0 + timeout
+    for t in threads:
+        t.join(timeout=max(0.0, deadline - time.perf_counter()))
+    dt = time.perf_counter() - t0
+
+    def _pct(xs, q):
+        return float(np.percentile(xs, q)) * 1e3 if xs else None
+
+    return {
+        "tok_s": tokens[0] / dt,
+        "gen_s": clients * per_client / dt,
+        "ttft_p50_ms": _pct(ttfts, 50),
+        "ttft_p99_ms": _pct(ttfts, 99),
+        "inter_token_p50_ms": _pct(itls, 50),
+        "inter_token_p99_ms": _pct(itls, 99),
+        "tokens": tokens[0],
         "wall_s": dt,
     }
 

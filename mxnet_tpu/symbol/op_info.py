@@ -36,6 +36,7 @@ OP_INPUTS = {
     "_contrib_quantized_conv": (
         ["data", "weight", "bias", "min_data", "max_data", "min_weight",
          "max_weight", "min_bias", "max_bias"], []),
+    "CausalSelfAttention": (["data"], []),
     "Activation": (["data"], []),
     "LeakyReLU": (["data", "gamma"], []),
     "Pooling": (["data"], []),

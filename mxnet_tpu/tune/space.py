@@ -29,7 +29,7 @@ import random
 from typing import Dict, List, Optional, Sequence
 
 __all__ = ["Knob", "SearchSpace", "pass_knobs", "tile_knobs",
-           "data_knobs", "serving_knobs", "batch_knob"]
+           "data_knobs", "serving_knobs", "decode_knobs", "batch_knob"]
 
 
 class Knob:
@@ -189,6 +189,26 @@ def serving_knobs(bucket_sets: Sequence[str],
              doc="Predictor bucket set"),
         Knob("max_wait_us", tuple(int(w) for w in waits), kind="param",
              doc="DynamicBatcher coalescing window"),
+    ]
+
+
+def decode_knobs(slot_counts: Sequence[int],
+                 bucket_sets: Sequence[str],
+                 waits: Sequence[int]) -> List[Knob]:
+    """Decode-serving frontier knobs: KV-cache lane count × prefill
+    seq-bucket set (comma-separated, the ``MXTPU_DECODE_SEQ_BUCKETS``
+    format) × first-fill window. Slots trade decode-step cost (every
+    lane rides every step) against continuous-batching concurrency;
+    buckets trade prefill program count against padding waste — only a
+    measured trial sees where TTFT and inter-token latency actually
+    balance."""
+    return [
+        Knob("slots", tuple(int(s) for s in slot_counts), kind="param",
+             doc="KV-cache lanes (concurrent generations)"),
+        Knob("seq_buckets", tuple(bucket_sets), kind="param",
+             doc="prefill seq-bucket set"),
+        Knob("max_wait_us", tuple(int(w) for w in waits), kind="param",
+             doc="DecodeBatcher first-fill window"),
     ]
 
 

@@ -36,11 +36,13 @@ from __future__ import annotations
 from typing import Dict, Optional, Sequence
 
 from .space import SearchSpace, Knob, pass_knobs, batch_knob, \
-    serving_knobs, data_knobs
+    serving_knobs, data_knobs, decode_knobs
 
 __all__ = ["Workload", "TrainStepWorkload", "ServingWorkload",
-           "DataPipelineWorkload", "conv_proxy", "sparse_proxy",
-           "builtin_workload", "measure_serving", "BUILTIN_WORKLOADS"]
+           "DecodeServingWorkload", "DataPipelineWorkload",
+           "conv_proxy", "sparse_proxy", "decode_proxy",
+           "builtin_workload", "measure_serving",
+           "measure_decode_serving", "BUILTIN_WORKLOADS"]
 
 
 class Workload:
@@ -286,6 +288,98 @@ class ServingWorkload(Workload):
 
 
 # ---------------------------------------------------------------------------
+# decode serving: token-SLO objective over slots × seq buckets × window
+# ---------------------------------------------------------------------------
+def measure_decode_serving(predictor, prompts, max_wait_us, clients,
+                           per_client=2, max_new_tokens=6, timeout=600):
+    """THE closed-loop decode measurement: streaming clients through a
+    DecodeBatcher over ``predictor`` (``loadgen.token_closed_loop``,
+    the one token-granularity driver — shared with
+    ``tools/serving_bench.py --decode`` and the bench section). The
+    objective folds both token SLOs into one end-to-end generation p99
+    proxy: ``ttft_p99 + max_new_tokens * inter_token_p99``."""
+    from ..serving import loadgen
+    from ..serving.decode import DecodeBatcher
+    predictor.warmup()
+    with DecodeBatcher(predictor, max_wait_us=max_wait_us,
+                       max_queue=100_000,
+                       name=f"tune-decode{max_wait_us}") as bat:
+        r = loadgen.token_closed_loop(
+            bat, prompts, clients, per_client,
+            max_new_tokens=max_new_tokens, timeout=timeout)
+        rep = bat.report()
+    ttft99 = r["ttft_p99_ms"] or 0.0
+    itl99 = r["inter_token_p99_ms"] or 0.0
+    return {
+        "objective": ttft99 + max_new_tokens * itl99,
+        "tok_s": r["tok_s"],
+        "ttft_p50_ms": r["ttft_p50_ms"],
+        "ttft_p99_ms": r["ttft_p99_ms"],
+        "inter_token_p50_ms": r["inter_token_p50_ms"],
+        "inter_token_p99_ms": r["inter_token_p99_ms"],
+        "tokens": r["tokens"],
+        "served_generations": rep["served_generations"],
+        "retraces": predictor.retraces,
+    }
+
+
+class DecodeServingWorkload(Workload):
+    """Slots × seq-bucket-set × first-fill-window search for a
+    DecodePredictor behind a DecodeBatcher. ``make_engine(slots,
+    seq_buckets)`` builds the engine for one (lanes, bucket-set) point —
+    the expensive compile half, cached per point; measurement is
+    :func:`measure_decode_serving` at a fixed streaming load (``budget``
+    scales the per-client generation count)."""
+
+    objective = "gen_p99_proxy_ms"
+
+    def __init__(self, name, make_engine, prompts,
+                 slot_counts: Sequence[int],
+                 bucket_sets: Sequence[str], waits: Sequence[int],
+                 space: Optional[SearchSpace] = None,
+                 clients: int = 4, per_client: int = 2,
+                 max_new_tokens: int = 6, spec=None):
+        space = space or SearchSpace(
+            decode_knobs(slot_counts, bucket_sets, waits),
+            name=f"{name}-decode")
+        super().__init__(space)
+        self.name = name
+        self.make_engine = make_engine
+        self.prompts = list(prompts)
+        self.clients = int(clients)
+        self.per_client = int(per_client)
+        self.max_new_tokens = int(max_new_tokens)
+        self.spec = spec
+        self._cache = {}
+
+    def key_material(self):
+        m = super().key_material()
+        if self.spec is not None:
+            m["extra"] = dict(m["extra"], **self.spec.key_material())
+        m["input_sigs"] = [
+            ("prompt_lens", tuple(int(p.shape[0]) for p in self.prompts)),
+            ("clients", self.clients),
+            ("per_client", self.per_client),
+            ("max_new_tokens", self.max_new_tokens)]
+        return m
+
+    def _engine(self, slots, buckets_spec):
+        key = (int(slots), str(buckets_spec))
+        if key not in self._cache:
+            buckets = tuple(int(b) for b in
+                            str(buckets_spec).split(","))
+            self._cache[key] = self.make_engine(int(slots), buckets)
+        return self._cache[key]
+
+    def measure(self, cfg, budget):
+        eng = self._engine(cfg["slots"], cfg["seq_buckets"])
+        return measure_decode_serving(
+            eng, self.prompts, int(cfg["max_wait_us"]), self.clients,
+            per_client=self.per_client * max(1, budget),
+            max_new_tokens=self.max_new_tokens)
+
+
+# ---------------------------------------------------------------------------
 # data pipeline: drain-wall objective over worker/staging knobs
 # ---------------------------------------------------------------------------
 class DataPipelineWorkload(Workload):
@@ -410,7 +504,38 @@ def sparse_proxy(batch: int = 8, batches=(8, 16, 32),
     return wl
 
 
-BUILTIN_WORKLOADS = {"conv": conv_proxy, "sparse": sparse_proxy}
+def decode_proxy(slot_counts=(2, 4), bucket_sets=("16", "16,32"),
+                 waits=(2000, 0), clients: int = 4,
+                 per_client: int = 2,
+                 max_new_tokens: int = 6) -> DecodeServingWorkload:
+    """The decode-family built-in: a pocket transformer LM
+    (serving/decode/model.py at interactive CPU size) searched over
+    KV-cache lanes × prefill buckets × first-fill window against the
+    token-SLO objective."""
+    import numpy as np
+    from ..serving.decode import TransformerLMSpec, DecodePredictor, \
+        init_params
+    spec = TransformerLMSpec(vocab_size=64, num_embed=32, num_heads=2,
+                             num_layers=2, max_seq=32, name="tunelm")
+    params = init_params(spec, seed=0)
+
+    def make_engine(slots, seq_buckets):
+        return DecodePredictor(spec, params, slots=slots,
+                               seq_buckets=seq_buckets)
+
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(1, spec.vocab_size, size=n).astype(np.int32)
+               for n in (5, 9, 3, 12, 7, 14)]
+    wl = DecodeServingWorkload(
+        "decode_lm", make_engine, prompts, slot_counts, bucket_sets,
+        waits, clients=clients, per_client=per_client,
+        max_new_tokens=max_new_tokens, spec=spec)
+    wl.builtin = "decode"
+    return wl
+
+
+BUILTIN_WORKLOADS = {"conv": conv_proxy, "sparse": sparse_proxy,
+                     "decode": decode_proxy}
 
 
 def builtin_workload(name: str, **kwargs) -> Workload:

@@ -1,0 +1,71 @@
+"""Chaos drill for the decode subsystem (round 16): SIGKILL mid-decode.
+
+The serving twin of the checkpoint/sparse/tune kill drills: a server
+is SIGKILLed at the ``decode_step`` faultinject site — generations in
+flight, KV-cache half-advanced, persistent compile cache already
+holding the decode programs — and the restarted server must come back
+clean:
+
+- no torn state: the kill run wrote no result file (its atomic
+  tmp+rename never committed) and the restarted run reads the shared
+  compile-cache directory with ``cache_errors == 0``;
+- bit-identical re-serving: the restarted server re-serves the
+  interrupted prompts to exactly the streams a never-killed run
+  produces (the KV-cache is process state, rebuilt from zero — nothing
+  durable to corrupt, which is itself the design claim being pinned).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = [pytest.mark.serving, pytest.mark.chaos]
+
+_TESTS = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(_TESTS, "decode_worker.py")
+
+
+def test_sigkill_mid_decode_restart_bit_identical(tmp_path):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
+                        "MXTPU_FAULT_INJECT")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXTPU_COMPILE_CACHE_DIR"] = str(tmp_path / "cache")
+
+    def run(outfile, fault=None):
+        e = dict(env)
+        if fault is not None:
+            e["MXTPU_FAULT_INJECT"] = fault
+        return subprocess.run(
+            [sys.executable, WORKER, str(outfile)],
+            capture_output=True, text=True, env=e, timeout=600)
+
+    # reference: a never-killed run
+    ref_file = tmp_path / "ref.json"
+    r0 = run(ref_file)
+    assert r0.returncode == 0, r0.stderr
+    assert "cache_errors=0" in r0.stdout
+    reference = json.loads(ref_file.read_text())
+    assert len(reference) == 4 and all(len(s) == 8 for s in reference)
+
+    # kill run: SIGKILL inside the 3rd continuous-batching decode step
+    # (prompts prefilled, generations mid-flight, compile cache warm)
+    kill_file = tmp_path / "killed.json"
+    r1 = run(kill_file, fault="decode_step:token=3:action=kill")
+    assert r1.returncode == -signal.SIGKILL
+    assert "faultinject: SIGKILL at site 'decode_step'" in r1.stdout
+    assert not kill_file.exists(), \
+        "the kill run must not commit a partial result file"
+
+    # restart: same cache dir — no torn compile-cache entry, and the
+    # interrupted prompts re-serve to bit-identical streams
+    restart_file = tmp_path / "restart.json"
+    r2 = run(restart_file)
+    assert r2.returncode == 0, r2.stderr
+    assert "cache_errors=0" in r2.stdout, (
+        "a compile-cache entry torn by the kill must be impossible "
+        f"(atomic entry commit): {r2.stdout}")
+    assert json.loads(restart_file.read_text()) == reference

@@ -253,6 +253,32 @@ def test_tune_suite_stays_tier1_with_chaos_marked():
         "pytest.mark.chaos like the other fault-injection suites")
 
 
+def test_decode_suite_stays_tier1_with_chaos_marked():
+    """The decode suite is tier-1's only proof that continuous-batched
+    token streams are bit-identical to solo decode, that serving
+    performs zero fresh compiles beyond per-bucket prefill + the one
+    decode program, and that the KV-cache moves strictly fewer bytes
+    per token than re-prefilling. It must (a) exist, (b) carry the
+    ``serving`` marker like the rest of the subsystem, (c) never carry
+    a ``slow`` mark that would drop those pins from the gate, and (d)
+    mark its SIGKILL-mid-decode restart drill ``chaos`` so ``-m chaos``
+    selects the whole fault surface."""
+    uses = _mark_uses()
+    for name in ("test_decode.py", "test_decode_chaos.py"):
+        path = os.path.join(_TESTS, name)
+        assert os.path.exists(path), f"decode suite {name} missing"
+        assert name in uses.get("serving", set()), (
+            f"{name} must carry pytest.mark.serving so '-m serving' "
+            "selects the whole serving subsystem")
+        assert name not in uses.get("slow", set()), (
+            f"{name} must stay tier-1: the bit-identity, zero-fresh-"
+            "compile, and bytes-per-token pins are round-16 acceptance "
+            "criteria")
+    assert "test_decode_chaos.py" in uses.get("chaos", set()), (
+        "the SIGKILL-mid-decode restart drill must carry "
+        "pytest.mark.chaos like the other fault-injection suites")
+
+
 def test_trace_memory_suite_stays_tier1_with_chaos_marked():
     """The trace/memory suite is tier-1's only proof that exported
     Chrome traces keep correct request→batch→bucket and step→phase

@@ -86,6 +86,12 @@ CASES = {
         grad_args=[0, 1, 2, 5], tol=(5e-2, 5e-3)),
     "LayerNorm": dict(
         inputs=[_signed((3, 6), 0), _pos((6,), 1), _signed((6,), 2)]),
+    "CausalSelfAttention": dict(
+        # packed QKV (B, S, 3*heads*head_dim) from the fused projection
+        # (round 16, serving/decode); the blockwise max/denominator
+        # recurrence is smooth in data, so plain FD applies.
+        inputs=[_signed((2, 4, 3 * 2 * 3), 0)],
+        attrs=dict(num_heads=2)),
     "InstanceNorm": dict(
         inputs=[_img((2, 3, 4, 4)), _pos((3,), 1), _signed((3,), 2)]),
     "L2Normalization": dict(inputs=[_signed((3, 5), 0)]),
