@@ -158,6 +158,118 @@ def transformer_serving(clients_list=(1, 8, 64)):
     }
 
 
+def fleet_serving(replicas_list=(1, 2, 4)):
+    """The r17 fleet-robustness section: a pocket MLP served through
+    the self-healing FleetRouter (serving/fleet.py). Headlines: router
+    p50 overhead vs the bare single batcher (the <= 5% pin — the
+    router must be close to free on the happy path), closed-loop req/s
+    at 1/2/4 replicas (capacity should scale), polite drain latency,
+    and the fleet shed rate (the `tools/telemetry.py diff
+    --gate-shed-rate` baseline)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving import loadgen
+
+    feat = 16
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=64, name="flt_fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="flt_relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=10, name="flt_fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    mod = mx.mod.Module(context=mx.cpu(), symbol=net)
+    mod.bind(data_shapes=[("data", (8, feat))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+
+    def factory():
+        pred = mod.as_predictor(buckets=(2, 8))
+        return serving.DynamicBatcher(pred, max_wait_us=1000,
+                                      max_queue=4096,
+                                      name="fleet-bench")
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, feat).astype(np.float32)
+
+    # Overhead pin: paired, interleaved trials. A single 60-request
+    # p50 at ~1.5 ms sits inside the DynamicBatcher's max_wait timer
+    # jitter, which is larger than the 5% threshold itself —
+    # alternating bare/router trials cancels slow host drift and the
+    # median across trials resolves the router's actual hop cost.
+    bare = factory()
+    bare.start()
+    router1 = serving.FleetRouter(factory, replicas=1,
+                                  name="bench-fleet1")
+    router1.start()
+    loadgen.closed_loop(bare, x, clients=2, per_client=10)     # warm
+    loadgen.closed_loop(router1, x, clients=2, per_client=10)  # warm
+    bare_p50s, router_p50s = [], []
+    run1 = None
+    for _ in range(3):
+        bare_p50s.append(loadgen.closed_loop(
+            bare, x, clients=2, per_client=50)["p50_ms"])
+        run1 = loadgen.closed_loop(router1, x, clients=2,
+                                   per_client=50,
+                                   retries=2, backoff_ms=10)
+        router_p50s.append(run1["p50_ms"])
+    rep1 = router1.report()
+    bare.stop()
+    router1.stop()
+    bare_p50 = float(np.median(bare_p50s))
+    router_p50 = float(np.median(router_p50s))
+
+    per_replicas = {"1": {
+        "req_s": round(run1["req_s"], 2),
+        "p50_ms": round(router_p50, 3),
+        "p99_ms": round(run1["p99_ms"], 3),
+    }}
+    drain_s = None
+    shed_rate = rep1["shed_rate"]
+    redispatched = rep1["redispatched"]
+    for n in replicas_list:
+        if n == 1:
+            continue
+        router = serving.FleetRouter(factory, replicas=n,
+                                     name=f"bench-fleet{n}")
+        router.start()
+        loadgen.closed_loop(router, x, clients=2, per_client=10)
+        run = loadgen.closed_loop(router, x, clients=2 * n,
+                                  per_client=30,
+                                  retries=2, backoff_ms=10)
+        if n >= 2 and drain_s is None:
+            drain_s = router.drain_slot(0)
+        rep = router.report()
+        shed_rate = rep["shed_rate"]
+        redispatched = rep["redispatched"]
+        per_replicas[str(n)] = {
+            "req_s": round(run["req_s"], 2),
+            "p50_ms": round(run["p50_ms"], 3),
+            "p99_ms": round(run["p99_ms"], 3),
+        }
+        router.stop()
+    overhead_pct = round((router_p50 / bare_p50 - 1.0) * 100.0, 3)
+    return {
+        "bare_p50_ms": round(bare_p50, 3),
+        "router_1rep_p50_ms": round(router_p50, 3),
+        "router_overhead_pct": overhead_pct,
+        "router_overhead_ok": overhead_pct <= 5.0,
+        "replicas": per_replicas,
+        "drain_s": round(drain_s, 4) if drain_s is not None else None,
+        "shed_rate": shed_rate,
+        "redispatched": redispatched,
+        "client_retries": loadgen.client_report(reset=True),
+        "note": "closed-loop clients through the FleetRouter "
+                "(serving/fleet.py): router_overhead_pct = fleet@1 "
+                "p50 over the bare DynamicBatcher p50, each the "
+                "median of 3 interleaved 100-request trials "
+                "(pin: <= 5%); "
+                "replicas table = same per-client load scaled with "
+                "the fleet; drain_s = polite drain_slot() latency on "
+                "a live fleet; shed_rate baselines "
+                "`telemetry.py diff --gate-shed-rate`",
+    }
+
+
 def main():
     import jax
     import mxnet_tpu as mx
@@ -882,6 +994,14 @@ print("BENCH " + json.dumps({
     except Exception:
         pass
 
+    # -- fleet serving (round 17): router overhead, replica scaling,
+    # drain latency, shed-rate baseline
+    fleet_serving_stats = None
+    try:
+        fleet_serving_stats = fleet_serving()
+    except Exception:
+        pass
+
     # -- HBM accounting (round 14): per-program peaks + process peak
     # from the compile registry's recorded memory_analysis — the
     # baseline `tools/telemetry.py diff --gate-peak-mem` compares
@@ -989,6 +1109,7 @@ print("BENCH " + json.dumps({
         "sparse_embedding": sparse_stats,
         "autotune": autotune_stats,
         "transformer_serving": transformer_serving_stats,
+        "fleet_serving": fleet_serving_stats,
         "memory": memory_stats,
         "telemetry": telemetry_snapshot,
         "host_decode_note": "multiprocess RecordIO->decode->augment->"
@@ -1015,5 +1136,10 @@ if __name__ == "__main__":
         print("BENCH " + json.dumps(
             {"metric": "transformer_serving",
              "transformer_serving": transformer_serving()}))
+    elif len(sys.argv) > 1 and sys.argv[1] == "fleet_serving":
+        # standalone fast mode: just the fleet-robustness section
+        print("BENCH " + json.dumps(
+            {"metric": "fleet_serving",
+             "fleet_serving": fleet_serving()}))
     else:
         main()

@@ -177,6 +177,35 @@ register("MXTPU_FT_DIST_BACKOFF", 0.5, float,
 register("MXTPU_FT_DIST_DEADLINE", 120.0, float,
          "Total seconds budget across dist retries and the host-level "
          "fallback collective's blocking KV reads/barriers")
+register("MXTPU_FLEET_PROBE_S", 0.25, float,
+         "FleetRouter health-probe interval (serving/fleet.py): how "
+         "often replica fault flags, straggler latency, and pending "
+         "replacements are checked")
+register("MXTPU_FLEET_MAX_FAILURES", 3, int,
+         "Consecutive request failures before the FleetRouter marks a "
+         "replica sick and drains it (a dead replica is drained on the "
+         "first probe regardless)")
+register("MXTPU_FLEET_STRAGGLER_FACTOR", 3.0, float,
+         "FleetRouter auto-drain rule: a replica whose median request "
+         "latency reaches this multiple of the median of replica "
+         "medians is drained and replaced (the serving twin of "
+         "tools/telemetry.py fleet's straggler flagging)")
+register("MXTPU_FLEET_MAX_REDISPATCH", 2, int,
+         "Max transparent re-dispatches of one request to another "
+         "replica after a replica failure/drain before the error "
+         "surfaces to the client")
+register("MXTPU_FLEET_LAT_WINDOW", 64, int,
+         "Per-replica latency samples the router keeps for the "
+         "straggler rule (and the minimum is an eighth of it: no "
+         "drain verdict off a cold replica's first requests)")
+register("MXTPU_FLEET_HEARTBEAT_S", 0.5, float,
+         "Elastic-training heartbeat lease renewal interval "
+         "(parallel/elastic.py): each rank republishes its lease in "
+         "the coordination KV store this often")
+register("MXTPU_FLEET_LEASE_S", 3.0, float,
+         "Heartbeat lease TTL: a rank whose lease is older than this "
+         "is declared lost and the survivors re-form at the new world "
+         "size (must comfortably exceed MXTPU_FLEET_HEARTBEAT_S)")
 register("MXTPU_DATA_PIPELINE", "auto", str,
          "Async host data pipeline (data/pipeline.py) wrapped around "
          "fit()'s train iterator: multi-worker decode, double-buffered "

@@ -4,7 +4,8 @@ Faults are armed **by site and ordinal**, never randomly: a spec names a
 site (``ckpt_write``, ``nan_grad``, ``data_iter``, ``data_worker``,
 ``dist_drop``, ``dist_init``, ``ckpt_truncate``, ``compile_cache``,
 ``telemetry_write``, ``sparse_update``, ``slow_step``,
-``tune_trial``, ``decode_step``) plus
+``tune_trial``, ``decode_step``, ``replica_drop``,
+``heartbeat_miss``) plus
 the exact coordinate at which it fires (byte offset, step index, batch
 index, call ordinal). ``telemetry_write`` is consulted by the durable
 telemetry exporter (telemetry/export.py) on every event append
@@ -38,7 +39,19 @@ program launch (``token=N``, the engine-wide step ordinal): a raise
 fails the in-flight generations with the KV-cache un-advanced, and
 ``action=kill`` is the SIGKILL-mid-decode drill — a restarted server
 must re-serve the interrupted prompts to bit-identical token streams
-from a clean compile cache. The same spec
+from a clean compile cache. ``replica_drop`` is consulted by every
+``Predictor._run_bucket`` micro-batch (serving/predictor.py):
+``call=N`` kills the N-th micro-batch fleet-wide,
+``replica=<telemetry id>`` targets one replica, ``action=kill``
+SIGKILLs the serving process, ``action=sleep:ms=N`` stretches batches
+(the straggler-replica drill), and a plain raise leaves the replica
+PERMANENTLY dead — the in-process replica-loss drill the FleetRouter
+(serving/fleet.py) must drain and replace with zero dropped requests.
+``heartbeat_miss`` is consulted at every elastic heartbeat-lease
+renewal (parallel/elastic.py): armed with ``times=K`` it suppresses K
+consecutive renewals, so the OTHER ranks see this rank's lease go
+stale and trigger the mesh re-form — the lost-worker detection drill
+without an actual kill. The same spec
 always produces the same failure, so CI chaos suites are reproducible
 bit-for-bit (contrast: the classic chaos-monkey coin flip, useless as a
 regression gate).

@@ -84,6 +84,19 @@ class KVStore:
             v0 = v[0] if isinstance(v, (list, tuple)) else v
             self._data[k] = v0.copy()
 
+    def set(self, key, value):
+        """Overwrite stored value(s) — unlike :meth:`init`, existing keys
+        are replaced. Needed when a bound module's params change after
+        ``init_optimizer`` (checkpoint restore / ``set_params``): with
+        update-on-kvstore the store holds the master weights, so later
+        pulls must return the new values, not the ones captured at init.
+        Callers must provide rank-consistent values in distributed mode
+        (checkpoint restores are: params are synced before every save)."""
+        keys, values = _as_key_list(key, value)
+        for k, v in zip(keys, values):
+            v0 = v[0] if isinstance(v, (list, tuple)) else v
+            self._data[k] = v0.copy()
+
     def push(self, key, value, priority=0):
         """Push (accumulate) values (reference: kvstore.py:130).
 

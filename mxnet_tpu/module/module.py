@@ -270,6 +270,15 @@ class Module(BaseModule):
             # set_params/init_params mid-run: push the new values into the
             # fused buffers (optimizer state is kept, like the eager path)
             self._fused.load_params(self._exec.arg_dict, self._exec.aux_dict)
+        if self._kvstore is not None and self._update_on_kvstore:
+            # update-on-kvstore: the store holds the master weights that
+            # every pull copies back over arg_dict, so set_params after
+            # init_optimizer (auto-resume restores a checkpoint here)
+            # must overwrite the master too — otherwise the first
+            # push/pull silently reverts training to the stale init
+            for i, name in enumerate(self._param_names):
+                if name in self._arg_params:
+                    self._kvstore.set(i, self._arg_params[name])
 
     def _sync_params_from_devices(self):
         """(reference: module.py:755)"""

@@ -31,12 +31,28 @@ from ..kvstore import KVStore
 from ..ndarray.ndarray import NDArray, _wrap
 
 __all__ = ["DistKVStore", "init", "barrier", "allreduce", "rank",
-           "world_size", "process_identity"]
+           "world_size", "process_identity", "notify_world_changed"]
 
 _initialized = [False]
 _host_fallback = [False]    # sticky: backend lacks multiproc collectives
+_fallback_world = [0]       # ...but only for the world that proved it
 _host_seq = [0]             # per-process collective ordinal (SPMD-matched)
 _barrier_seq = [0]
+
+
+def _fallback_active():
+    """Is the sticky host-transport fallback still valid? The stickiness
+    is keyed to the world size that PROVED the backend limitation: after
+    an elastic re-form the device set and fabric are different, so the
+    old world's evidence no longer applies — reset and re-probe the fast
+    path instead of degrading the new mesh forever (round 17)."""
+    if not _host_fallback[0]:
+        return False
+    if _fallback_world[0] != world_size():
+        _host_fallback[0] = False
+        _fallback_world[0] = 0
+        return False
+    return True
 
 
 def _ft_cfg():
@@ -153,7 +169,7 @@ def barrier():
         return
     _barrier_seq[0] += 1
     seq = _barrier_seq[0]
-    if not _host_fallback[0]:
+    if not _fallback_active():
         try:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices(f"mxnet_tpu_barrier_{seq}")
@@ -223,12 +239,35 @@ def _collective_unsupported(e):
 def _note_fallback(e):
     if not _host_fallback[0]:
         _host_fallback[0] = True
+        _fallback_world[0] = world_size()
         fault.count("dist.collective_fallbacks")
         warnings.warn(
             "backend cannot run multi-process collectives "
             f"({str(e).splitlines()[0][:120]}); degrading to the "
             "host-level allgather-sum over the jax coordination service "
             "— correct but slower (parallel/dist.py)")
+
+
+def notify_world_changed():
+    """Reset every piece of per-world collective state after an elastic
+    mesh re-form (parallel/elastic.py): the global-mesh and
+    reduce-program caches (keyed on the dead world's device set), the
+    SPMD collective/barrier ordinals (a re-formed job starts its
+    sequence from zero on every survivor, or ordinals would disagree
+    across ranks that joined at different generations), the sticky
+    host-transport fallback, and the init latch. Barrier re-entry
+    during the re-form runs under the same
+    ``MXTPU_FT_DIST_RETRIES/BACKOFF/DEADLINE`` policy as any other
+    degraded transport — a survivor blocks at most ``deadline`` seconds
+    for peers that never arrive, then fails with a diagnosable
+    ``MXNetError`` instead of hanging the fleet."""
+    _mesh_cache.clear()
+    _reduce_cache.clear()
+    _host_seq[0] = 0
+    _barrier_seq[0] = 0
+    _host_fallback[0] = False
+    _fallback_world[0] = 0
+    _initialized[0] = False
 
 
 def allreduce_batch(arrays):
@@ -257,7 +296,7 @@ def allreduce_batch(arrays):
     flat = jnp.concatenate([a.astype(dtype).ravel() for a in arrays]) \
         if arrays else jnp.zeros((0,), dtype)
 
-    if not _host_fallback[0]:
+    if not _fallback_active():
         try:
             summed = _allreduce_device(flat)
         except Exception as e:
@@ -296,6 +335,14 @@ def _allreduce_host_flat(flat):
     traffic through the coordinator — the degraded-mode transport, not
     the fast path."""
     import jax
+    from .. import faultinject
+    # the same transport fault site as _allreduce_device: on backends
+    # already in host fallback (CPU), ``dist_drop:call=K:action=kill``
+    # is the kill-rank-mid-collective drill the elastic supervisor
+    # recovers from (parallel/elastic.py); a plain raise here is a
+    # hard transport error — there is no further fallback below this
+    if faultinject.fire("dist_drop"):
+        raise faultinject.FaultInjected("dist_drop")
     client = _kv_client()
     _, _, deadline = _ft_cfg()
     tmo = int(deadline * 1000)

@@ -1,0 +1,466 @@
+"""Elastic training recovery: survive worker loss, re-form, continue.
+
+The fault-tolerance layer up to round 16 could survive every failure
+EXCEPT losing a worker process: checkpoints are atomic (checkpoint.py),
+collectives retry under a deadline (dist.py ``_retry``), but a
+SIGKILLed rank left the survivors blocked in a barrier until the
+``MXTPU_FT_DIST_DEADLINE`` expired and then the whole job died — the
+documented "no elastic rejoin" gap in docs/faq/failure_recovery.md.
+This module closes it, with the same health model the serving
+FleetRouter (serving/fleet.py) uses for replicas:
+
+- **detection** — every rank renews a heartbeat *lease* in the jax
+  coordination-service KV store (:class:`HeartbeatLease`, renewed every
+  ``MXTPU_FLEET_HEARTBEAT_S``, stale after ``MXTPU_FLEET_LEASE_S``).
+  Survivors notice a lost peer from its stale lease — usually BEFORE
+  the next collective would block on it — and raise
+  :class:`WorldChanged` at a batch boundary (:class:`ElasticGuard`).
+  A collective that does block on the dead rank fails within the
+  ``MXTPU_FT_DIST_*`` deadline; the guard classifies that failure the
+  same way. The ``heartbeat_miss`` fault site drills detection without
+  an actual kill (suppressed renewals → peers see a stale lease).
+- **re-form** — jax pins the process count at ``distributed.initialize``
+  time, so the mesh cannot shrink in place: a survivor exits with
+  :data:`REFORM_EXIT` (75) and the :class:`ElasticSupervisor` relaunches
+  the survivors as a NEW generation at the new world size, on a fresh
+  coordinator port (``dist.notify_world_changed()`` covers the
+  in-process state for single-process tests and future in-place
+  backends).
+- **recovery** — the relaunched generation restores params + optimizer
+  state from the newest checkpoint (rank 0 writes them via
+  :class:`ElasticCheckpointManager`, which stamps ``world``/``rank``/
+  ``generation`` into the checkpoint's ``extra``); data shards are
+  recomputed from ``(rank, world)``. Same world size → the r9 data
+  cursor restores too and resume is **bit-exact**; changed world → the
+  cursor (recorded under the dead world's sharding) is discarded with a
+  warning and the epoch re-shards from its start
+  (:func:`prepare_resume`).
+- **rejoin** — a later generation launched at a larger world is just
+  another re-form; the rejoining rank AOT-loads its programs from the
+  shared persistent compile cache (``MXTPU_COMPILE_CACHE_DIR``) and
+  catches up without a single fresh XLA compile.
+
+Scope: the supervisor relaunches on ONE host (the multi-process drill
+topology); rank 0 doubles as coordinator host, so its loss takes the
+coordination service with it — a cluster scheduler's restart policy
+owns that case (documented in failure_recovery.md).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+import warnings
+
+from ..base import MXNetError
+from ..checkpoint import CheckpointManager
+
+__all__ = ["REFORM_EXIT", "WorldChanged", "HeartbeatLease",
+           "ElasticGuard", "ElasticCheckpointManager", "prepare_resume",
+           "ElasticSupervisor", "generation_from_env", "exit_for_reform"]
+
+# exit code a survivor uses to ask its supervisor for a mesh re-form
+# (chosen clear of shell/signal codes: 0=done, 1=error, 128+N=signal)
+REFORM_EXIT = 75
+
+
+def exit_for_reform():
+    """Exit this worker with :data:`REFORM_EXIT` — via ``os._exit``, NOT
+    ``sys.exit``. A plain exit runs the interpreter's atexit hooks,
+    and jax.distributed registers a shutdown barrier there: with a dead
+    peer that barrier blocks for the full coordination-service timeout
+    (minutes) and then SIGABRTs the process, so the supervisor would see
+    a crash instead of a re-form request. Streams are flushed first."""
+    try:
+        sys.stdout.flush()
+        sys.stderr.flush()
+    except Exception:        # noqa: BLE001 - nothing useful to do mid-exit
+        pass
+    os._exit(REFORM_EXIT)
+
+
+class WorldChanged(MXNetError):
+    """A peer's heartbeat lease went stale (or a collective failed on a
+    dead rank): the world this process initialized with no longer
+    exists. Raised at a batch boundary so the training loop can exit
+    cleanly with :data:`REFORM_EXIT`."""
+
+    def __init__(self, lost, world):
+        super().__init__(
+            f"elastic: lost rank(s) {sorted(lost)} of world {world}; "
+            "mesh re-form required")
+        self.lost = sorted(lost)
+        self.world = world
+
+
+def _cfg():
+    from .. import config
+    return (float(config.get("MXTPU_FLEET_HEARTBEAT_S")),
+            float(config.get("MXTPU_FLEET_LEASE_S")))
+
+
+def _hb_key(generation, rank):
+    return f"mxtpu_el/g{generation}/hb/{rank}"
+
+
+class HeartbeatLease:
+    """Renew this rank's liveness lease and watch every peer's.
+
+    One daemon thread per process: each tick it (1) re-publishes its
+    own key (``mxtpu_el/g<gen>/hb/<rank>`` → a wall-clock timestamp)
+    unless the ``heartbeat_miss`` fault site eats the renewal, and (2)
+    reads every peer's key, marking a peer lost once its timestamp is
+    older than the lease TTL (``MXTPU_FLEET_LEASE_S``) or the key has
+    repeatedly failed to materialize. Lost peers are sticky — a rank
+    that died stays dead for this generation; the re-formed generation
+    starts a fresh key namespace.
+
+    Timestamps compare across processes on the same host (the supervisor
+    topology); cross-host deployment assumes clocks synchronized well
+    within the lease TTL (NTP is orders of magnitude tighter).
+    """
+
+    def __init__(self, rank=None, world=None, generation=0,
+                 heartbeat_s=None, lease_s=None):
+        from . import dist
+        self.rank = dist.rank() if rank is None else int(rank)
+        self.world = dist.world_size() if world is None else int(world)
+        self.generation = int(generation)
+        hb, lease = _cfg()
+        self.heartbeat_s = float(heartbeat_s or hb)
+        self.lease_s = float(lease_s or lease)
+        self._client = dist._kv_client()
+        self._lost = set()
+        self._strikes = {}     # peer rank -> consecutive failed reads
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        self.renewals = 0
+        self.missed = 0
+
+    # -- lease publishing ------------------------------------------------------
+    def _publish(self):
+        from .. import faultinject
+        if faultinject.fire("heartbeat_miss", rank=self.rank):
+            self.missed += 1
+            return
+        key = _hb_key(self.generation, self.rank)
+        val = f"{time.time():.6f}".encode()
+        try:
+            self._client.key_value_set_bytes(key, val,
+                                             allow_overwrite=True)
+        except TypeError:      # older client: no allow_overwrite kwarg
+            try:
+                self._client.key_value_delete(key)
+            except Exception:                  # noqa: BLE001
+                pass
+            self._client.key_value_set_bytes(key, val)
+        self.renewals += 1
+
+    def _check_peer(self, peer):
+        try:
+            raw = self._client.blocking_key_value_get_bytes(
+                _hb_key(self.generation, peer),
+                max(50, int(self.heartbeat_s * 1000)))
+        except Exception:                      # noqa: BLE001
+            # key absent within the wait: strike (a peer that never
+            # published within a full lease worth of ticks is lost too)
+            self._strikes[peer] = self._strikes.get(peer, 0) + 1
+            return self._strikes[peer] * self.heartbeat_s >= \
+                self.lease_s
+        self._strikes[peer] = 0
+        try:
+            age = time.time() - float(raw.decode())
+        except ValueError:
+            return False
+        return age > self.lease_s
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self._publish()
+                for peer in range(self.world):
+                    if peer == self.rank:
+                        continue
+                    with self._lock:
+                        if peer in self._lost:
+                            continue
+                    if self._check_peer(peer):
+                        with self._lock:
+                            self._lost.add(peer)
+            except Exception:                  # noqa: BLE001
+                pass   # transport hiccups must not kill the monitor
+            self._stop.wait(self.heartbeat_s)
+
+    # -- lifecycle -------------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._publish()        # lease exists before any peer checks it
+        self._thread = threading.Thread(
+            target=self._loop, name=f"hb-lease-r{self.rank}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.heartbeat_s * 4 + 2)
+            self._thread = None
+        try:
+            self._client.key_value_delete(
+                _hb_key(self.generation, self.rank))
+        except Exception:                      # noqa: BLE001
+            pass
+
+    def lost_peers(self):
+        with self._lock:
+            return sorted(self._lost)
+
+
+class ElasticGuard:
+    """Training-loop wrapper that turns peer loss into a clean
+    :class:`WorldChanged` at a batch boundary::
+
+        with elastic.ElasticGuard(generation=gen) as guard:
+            try:
+                mod.fit(..., batch_end_callback=guard.batch_end_callback)
+            except Exception as e:
+                if guard.should_reform(e):
+                    elastic.exit_for_reform()
+                raise
+
+    ``batch_end_callback`` raises as soon as the lease monitor flags a
+    peer; a collective that failed FIRST (it blocked on the dead rank
+    until the ``MXTPU_FT_DIST_DEADLINE``) reaches ``should_reform``,
+    which re-checks the leases to distinguish "peer died" (re-form)
+    from a genuine program error (re-raise). Single-process worlds need
+    no lease and never re-form."""
+
+    def __init__(self, generation=0, lease=None):
+        from . import dist
+        self.world = dist.world_size()
+        self.generation = int(generation)
+        self._lease = lease
+        if self._lease is None and self.world > 1:
+            self._lease = HeartbeatLease(generation=generation)
+
+    def __enter__(self):
+        if self._lease is not None:
+            self._lease.start()
+        return self
+
+    def __exit__(self, *exc):
+        if self._lease is not None:
+            self._lease.stop()
+        return False
+
+    def lost_peers(self):
+        return self._lease.lost_peers() if self._lease else []
+
+    def batch_end_callback(self, param=None):
+        lost = self.lost_peers()
+        if lost:
+            raise WorldChanged(lost, self.world)
+
+    def should_reform(self, error):
+        """Did ``error`` mean "the world changed"? True for
+        :class:`WorldChanged` itself and for any failure observed while
+        a peer's lease is stale (the collective found out the hard
+        way). Waits one extra heartbeat before deciding: the collective
+        deadline usually fires before the lease does."""
+        if isinstance(error, WorldChanged):
+            return True
+        if self._lease is None:
+            return False
+        if not self.lost_peers():
+            time.sleep(self._lease.lease_s)
+        return bool(self.lost_peers())
+
+
+class ElasticCheckpointManager(CheckpointManager):
+    """CheckpointManager that stamps the elastic identity —
+    ``{"world", "rank", "generation"}`` — into every checkpoint's
+    ``extra`` (the fit loop's epoch-end save passes no ``extra`` of its
+    own, so the stamp must live in the manager). ``prepare_resume``
+    reads it back to decide between bit-exact cursor restore and an
+    epoch-granularity re-shard."""
+
+    def __init__(self, directory, world=None, rank=None, generation=0,
+                 **kw):
+        super().__init__(directory, **kw)
+        from . import dist
+        self.world = dist.world_size() if world is None else int(world)
+        self.rank = dist.rank() if rank is None else int(rank)
+        self.generation = int(generation)
+
+    def save_module(self, module, epoch, nbatch=0, eval_metric=None,
+                    extra=None, data_state=None):
+        extra = dict(extra or {})
+        extra["elastic"] = {"world": self.world, "rank": self.rank,
+                            "generation": self.generation}
+        return super().save_module(module, epoch, nbatch=nbatch,
+                                   eval_metric=eval_metric, extra=extra,
+                                   data_state=data_state)
+
+
+def prepare_resume(manager, train_data, world=None, rank=None):
+    """Pre-``fit`` resume policy for an elastic generation: load the
+    newest checkpoint's elastic stamp and decide what the data iterator
+    may restore.
+
+    Same world size as the checkpoint → nothing to do: ``fit``'s
+    auto-resume restores params, optimizer state AND the r9 data cursor
+    — the relaunched generation replays the exact surviving schedule
+    (bit-exact resume, pinned by the chaos drill).
+
+    Different world size → the saved cursor describes the DEAD world's
+    ``(rank, world)`` sharding; restoring it would skip or double-read
+    rows. The cursor restore is disabled (``train_data.set_state`` is
+    shadowed with ``None`` on the *instance* — ``fit`` checks
+    ``callable(...)`` and skips silently) and the epoch re-shards from
+    its start under the new world, which is the correct
+    epoch-granularity recovery.
+
+    Returns the :class:`~mxnet_tpu.checkpoint.CheckpointState` (or None
+    when there is nothing to resume from)."""
+    from . import dist
+    world = dist.world_size() if world is None else int(world)
+    rank = dist.rank() if rank is None else int(rank)
+    state = manager.load_latest()
+    if state is None:
+        return None
+    stamp = (state.extra or {}).get("elastic") or {}
+    old_world = stamp.get("world")
+    if old_world is not None and int(old_world) != world:
+        warnings.warn(
+            f"elastic resume: checkpoint '{state.path}' was written at "
+            f"world={old_world}, resuming at world={world} — data "
+            "cursor discarded, epoch re-shards from its start "
+            f"(rank {rank}/{world})")
+        try:
+            train_data.set_state = None
+        except Exception:                      # noqa: BLE001
+            pass
+    return state
+
+
+class ElasticSupervisor:
+    """Single-host supervisor: launch one worker process per rank, and
+    when ranks die (SIGKILL, ``dist_drop:action=kill``) or ask for a
+    re-form (:data:`REFORM_EXIT`), relaunch the survivors as the next
+    generation at the shrunken world size — each generation on a fresh
+    coordinator port with a fresh heartbeat namespace. A ``rejoin``
+    schedule grows a later generation back (the recovered host): the
+    relaunch is identical, only the world is larger.
+
+    ``argv_fn(rank, world, generation, coordinator)`` builds one
+    worker's command line; the supervisor additionally exports
+    ``COORDINATOR_ADDRESS`` / ``NUM_PROCESSES`` / ``PROCESS_ID`` /
+    ``MXTPU_ELASTIC_GENERATION`` into its environment, so a worker can
+    use either surface."""
+
+    def __init__(self, argv_fn, world, min_world=1, max_generations=6,
+                 env=None, timeout_s=240, port_fn=None, logger=None,
+                 fault=None, fault_rank=0, fault_generation=0):
+        self.argv_fn = argv_fn
+        self.world = int(world)
+        self.min_world = int(min_world)
+        self.max_generations = int(max_generations)
+        self.env = dict(env) if env else dict(os.environ)
+        self.timeout_s = float(timeout_s)
+        self._port_fn = port_fn or self._free_port
+        # arm a MXTPU_FAULT_INJECT spec on exactly ONE (rank, generation)
+        # — the drill victim; every other worker runs clean
+        self.fault = fault
+        self.fault_rank = int(fault_rank)
+        self.fault_generation = int(fault_generation)
+        import logging
+        self.logger = logger or logging.getLogger("mxnet_tpu.elastic")
+        self.history = []    # one record per generation
+
+    @staticmethod
+    def _free_port():
+        import socket
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def _launch(self, rank, world, generation, coordinator):
+        env = dict(self.env)
+        env.pop("MXTPU_FAULT_INJECT", None)
+        if self.fault and rank == self.fault_rank and \
+                generation == self.fault_generation:
+            env["MXTPU_FAULT_INJECT"] = self.fault
+        env["COORDINATOR_ADDRESS"] = coordinator
+        env["NUM_PROCESSES"] = str(world)
+        env["PROCESS_ID"] = str(rank)
+        env["MXTPU_ELASTIC_GENERATION"] = str(generation)
+        argv = self.argv_fn(rank, world, generation, coordinator)
+        return subprocess.Popen(argv, env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT)
+
+    def run(self, rejoin=None):
+        """Drive generations until a generation where EVERY rank exits
+        0 (training finished) or limits are hit. ``rejoin`` maps
+        ``generation -> world size`` overrides (e.g. ``{2: 3}``: the
+        third generation launches 3 ranks regardless of survivor
+        count). Returns ``self.history`` — per generation: world, exit
+        codes, lost ranks, outcome."""
+        rejoin = dict(rejoin or {})
+        world = self.world
+        for gen in range(self.max_generations):
+            world = int(rejoin.get(gen, world))
+            if world < self.min_world:
+                raise MXNetError(
+                    f"elastic: world shrank to {world} < min_world="
+                    f"{self.min_world} at generation {gen}")
+            coordinator = f"127.0.0.1:{self._port_fn()}"
+            self.logger.info("elastic gen %d: launching world=%d (%s)",
+                             gen, world, coordinator)
+            procs = [self._launch(r, world, gen, coordinator)
+                     for r in range(world)]
+            codes, logs = [], []
+            deadline = time.monotonic() + self.timeout_s
+            for p in procs:
+                try:
+                    out, _ = p.communicate(
+                        timeout=max(1.0, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    out, _ = p.communicate()
+                codes.append(p.returncode)
+                logs.append((out or b"").decode(errors="replace"))
+            lost = [r for r, c in enumerate(codes)
+                    if c not in (0, REFORM_EXIT)]
+            record = {"generation": gen, "world": world,
+                      "coordinator": coordinator, "codes": codes,
+                      "lost": lost, "logs": logs}
+            self.history.append(record)
+            if all(c == 0 for c in codes):
+                record["outcome"] = "done"
+                return self.history
+            if not any(c == REFORM_EXIT for c in codes) and not lost \
+                    and (gen + 1) not in rejoin:
+                record["outcome"] = "failed"
+                raise MXNetError(
+                    f"elastic gen {gen}: workers failed without "
+                    f"requesting re-form (codes={codes});\n"
+                    + "\n".join(logs))
+            record["outcome"] = "reform"
+            world = world - len(lost)
+        raise MXNetError(
+            f"elastic: no generation finished within "
+            f"{self.max_generations} re-forms")
+
+
+def generation_from_env(default=0):
+    """The generation stamp the supervisor exported for this worker."""
+    try:
+        return int(os.environ.get("MXTPU_ELASTIC_GENERATION", default))
+    except ValueError:
+        return int(default)

@@ -34,8 +34,9 @@ import weakref
 
 from ..base import MXNetError
 
-__all__ = ["Predictor", "DynamicBatcher", "ServingError", "Overloaded",
-           "DeadlineExceeded", "Cancelled", "serving_report", "decode"]
+__all__ = ["Predictor", "DynamicBatcher", "FleetRouter", "ServingError",
+           "Overloaded", "DeadlineExceeded", "Cancelled",
+           "serving_report", "decode"]
 
 
 class ServingError(MXNetError):
@@ -74,9 +75,11 @@ import itertools as _itertools
 _PREDICTORS: "weakref.WeakSet" = weakref.WeakSet()
 _BATCHERS: "weakref.WeakSet" = weakref.WeakSet()
 _DECODERS: "weakref.WeakSet" = weakref.WeakSet()
+_ROUTERS: "weakref.WeakSet" = weakref.WeakSet()
 _PRED_SEQ = _itertools.count()
 _BATCH_SEQ = _itertools.count()
 _DECODE_SEQ = _itertools.count()
+_ROUTER_SEQ = _itertools.count()
 
 
 def _register_predictor(p):
@@ -106,6 +109,15 @@ def _register_decoder(d):
     weakref.finalize(d, treg.remove, f"serving::{d.telemetry_id}::")
 
 
+def _register_router(r):
+    """FleetRouter registration (serving/fleet.py): stable id + cleanup
+    of its ``fleet::<id>::…`` registry series when the router dies."""
+    r.telemetry_id = f"{r.name or 'fleet'}#{next(_ROUTER_SEQ)}"
+    _ROUTERS.add(r)
+    from ..telemetry import registry as treg
+    weakref.finalize(r, treg.remove, f"fleet::{r.telemetry_id}::")
+
+
 def _collect(reset: bool = False) -> dict:
     """Aggregate serving observability: one entry per live Predictor
     (per-bucket compile/call/pad counters, retraces) and per live
@@ -127,6 +139,10 @@ def _collect(reset: bool = False) -> dict:
         "decoders": sorted(
             (d.report(reset=reset) for d in list(_DECODERS)),
             key=lambda r: r["id"]),
+        "routers": sorted(
+            (r.report(reset=reset) for r in list(_ROUTERS)),
+            key=lambda r: r["id"]),
+        "clients": loadgen.client_report(reset=reset),
     }
     if reset:
         _treg.reset(prefix="serving::")
@@ -142,3 +158,4 @@ from .predictor import Predictor           # noqa: E402
 from .batcher import DynamicBatcher        # noqa: E402
 from . import loadgen                      # noqa: E402
 from . import decode                       # noqa: E402
+from .fleet import FleetRouter             # noqa: E402

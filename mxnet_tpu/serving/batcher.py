@@ -47,23 +47,51 @@ _DEADLINE_SLACK_S = 0.002  # launch this early so an at-deadline
                            # request is still live when collected
 
 
+def _run_callback(cb, fut):
+    try:
+        cb(fut)
+    except Exception:                      # noqa: BLE001
+        import logging
+        logging.getLogger("mxnet_tpu.serving").exception(
+            "ServingFuture done-callback failed")
+
+
 class ServingFuture:
     """Completion handle for one submitted request. ``trace_id`` is the
     request's id in the structured-trace/event-log surfaces — a client
     can log it and correlate its own latency with the server's spans."""
 
-    __slots__ = ("_event", "_result", "_error", "trace_id")
+    __slots__ = ("_event", "_result", "_error", "trace_id", "_cb_lock",
+                 "_callbacks")
 
     def __init__(self):
         self._event = threading.Event()
         self._result = None
         self._error = None
         self.trace_id = None
+        self._cb_lock = threading.Lock()
+        self._callbacks = []
 
     def _complete(self, result=None, error=None):
         self._result = result
         self._error = error
-        self._event.set()
+        with self._cb_lock:
+            self._event.set()
+            cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:
+            _run_callback(cb, self)
+
+    def add_done_callback(self, fn):
+        """Run ``fn(self)`` once the future completes (immediately when
+        it already has). Callbacks run on the completing thread — the
+        batching loop — so they must be quick and must not block; the
+        FleetRouter's transparent re-dispatch hangs off this hook.
+        Exceptions are logged, never propagated into the serving loop."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        _run_callback(fn, self)
 
     def done(self):
         return self._event.is_set()

@@ -59,7 +59,8 @@ class StreamFuture:
     iterator (and ``result``) raises the error — ``Cancelled`` on
     ``stop(drain=False)``, never a hang."""
 
-    __slots__ = ("_cond", "_tokens", "_done", "_error", "trace_id")
+    __slots__ = ("_cond", "_tokens", "_done", "_error", "trace_id",
+                 "_callbacks")
 
     def __init__(self):
         self._cond = threading.Condition()
@@ -67,6 +68,7 @@ class StreamFuture:
         self._done = False
         self._error = None
         self.trace_id = None
+        self._callbacks = []
 
     # producer side (batcher loop)
     def _push(self, tok):
@@ -80,7 +82,22 @@ class StreamFuture:
                 return
             self._done = True
             self._error = error
+            cbs, self._callbacks = self._callbacks, []
             self._cond.notify_all()
+        from ..batcher import _run_callback
+        for cb in cbs:
+            _run_callback(cb, self)
+
+    def add_done_callback(self, fn):
+        """Run ``fn(self)`` when the stream terminates (immediately when
+        it already has) — same contract as ``ServingFuture``; the fleet
+        router's replica-health accounting hangs off this hook."""
+        with self._cond:
+            if not self._done:
+                self._callbacks.append(fn)
+                return
+        from ..batcher import _run_callback
+        _run_callback(fn, self)
 
     def _complete(self, result=None, error=None):
         """Base-class completion contract (DynamicBatcher.stop shedding

@@ -1,0 +1,572 @@
+"""Self-healing serving fleet: N replicas behind a least-loaded router.
+
+One ``Predictor`` behind one ``DynamicBatcher`` is a single point of
+failure: a poisoned program, a stuck device, or a straggling host takes
+every client down with it. The FleetRouter is the layer the reference
+framework delegated to its parameter-server tracker and modern serving
+stacks put in front of model replicas: N independent replicas (each its
+own batcher + compiled programs), least-loaded dispatch over the
+per-replica bounded queues, fleet-level admission control, and a
+drain/replace state machine fed by the same health signals the r14
+fleet telemetry uses for training ranks.
+
+Replica lifecycle::
+
+    STARTING --warmup ok--> HEALTHY --fault/straggler--> DRAINING
+                               ^                             |
+                               |        (queue re-routed,    v
+    replacement spin-up  <-- DEAD <---- in-flight completes) +
+
+- a **killed** replica (``replica_drop`` fault, poisoned program) is
+  detected by its permanent fault flag or consecutive failures: its
+  queued requests are shed (``stop(drain=False)``) and transparently
+  re-dispatched to healthy replicas through the futures' done-callbacks
+  — the client's future completes with a RESULT, never the replica's
+  death;
+- a **sick** replica (median request latency >=
+  ``MXTPU_FLEET_STRAGGLER_FACTOR`` x the median of replica medians —
+  the serving twin of ``tools/telemetry.py fleet``'s straggler rule) is
+  drained politely (``stop(drain=True)`` serves its queue first);
+- **replacement** spin-up is cheap by construction: the factory's new
+  Predictor AOT-loads every bucket program from the persistent compile
+  cache (r10), so a replacement performs ZERO fresh XLA compiles on a
+  warm cache — the chaos drill pins this.
+
+Routing is duck-typed over both serving batchers: stateless
+``DynamicBatcher`` requests get transparent re-dispatch; streaming
+``DecodeBatcher`` generations get least-loaded placement, fleet
+admission, and health accounting, but a generation that already
+streamed tokens is never silently replayed — a mid-stream failure
+surfaces (drain completes it instead).
+
+Trace ids propagate router -> replica: the returned future carries the
+replica-assigned ``trace_id`` and every route/redispatch/shed lands as
+a ``fleet_*`` telemetry event under it, so ``tools/telemetry.py
+fleet`` can render whole-fleet request timelines and a Chrome trace
+shows fleet:request -> serving:batch -> serving:bucket as one tree.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import config
+from ..base import MXNetError
+from ..telemetry import trace as _trace
+from . import DeadlineExceeded, Overloaded, _register_router
+from .batcher import ServingFuture
+
+__all__ = ["FleetRouter"]
+
+# replica lifecycle states
+STARTING = "starting"
+HEALTHY = "healthy"
+DRAINING = "draining"
+DEAD = "dead"
+
+
+class _Replica:
+    """One fleet slot: the current batcher occupying it plus the
+    router-side health ledger (consecutive failures, latency window)."""
+
+    __slots__ = ("slot", "batcher", "state", "consec_failures", "lats",
+                 "served", "redispatched_away", "generation")
+
+    def __init__(self, slot, batcher, generation=0):
+        self.slot = slot
+        self.batcher = batcher
+        self.state = STARTING
+        self.consec_failures = 0
+        self.lats = []            # recent request latencies (seconds)
+        self.served = 0
+        self.redispatched_away = 0
+        self.generation = generation
+
+    @property
+    def predictor(self):
+        return self.batcher.predictor
+
+    def queue_depth(self):
+        try:
+            return self.batcher.queue_depth
+        except Exception:        # noqa: BLE001 — a dying replica sorts last
+            return float("inf")
+
+
+def _median(xs):
+    s = sorted(xs)
+    return s[len(s) // 2] if s else None
+
+
+class FleetRouter:
+    """Route requests across ``replicas`` batcher replicas.
+
+    Parameters
+    ----------
+    replica_factory : callable () -> DynamicBatcher/DecodeBatcher
+        Builds one fresh (unstarted) replica — also how replacements
+        spin up, so it must be safe to call while the fleet serves.
+        Point ``MXTPU_COMPILE_CACHE_DIR`` at a shared cache and every
+        replica past the first (and every replacement) AOT-loads its
+        bucket programs instead of compiling.
+    replicas : int
+        Fleet size the router maintains (dead replicas are replaced).
+    name : str
+        Label for telemetry ids and report entries.
+    probe_interval_s / max_failures / straggler_factor /
+    max_redispatch : optional
+        Override the ``MXTPU_FLEET_*`` defaults (config.py).
+    """
+
+    def __init__(self, replica_factory, replicas=2, name="fleet",
+                 probe_interval_s=None, max_failures=None,
+                 straggler_factor=None, max_redispatch=None):
+        if replicas < 1:
+            raise MXNetError("FleetRouter needs at least one replica")
+        self._factory = replica_factory
+        self._n = int(replicas)
+        self.name = name
+        self.probe_interval_s = float(
+            probe_interval_s if probe_interval_s is not None
+            else config.get("MXTPU_FLEET_PROBE_S", 0.25))
+        self.max_failures = int(
+            max_failures if max_failures is not None
+            else config.get("MXTPU_FLEET_MAX_FAILURES", 3))
+        self.straggler_factor = float(
+            straggler_factor if straggler_factor is not None
+            else config.get("MXTPU_FLEET_STRAGGLER_FACTOR", 3.0))
+        self.max_redispatch = int(
+            max_redispatch if max_redispatch is not None
+            else config.get("MXTPU_FLEET_MAX_REDISPATCH", 2))
+        self._lat_window = int(config.get("MXTPU_FLEET_LAT_WINDOW", 64))
+        self._min_lat_samples = max(4, self._lat_window // 8)
+        self._lock = threading.RLock()
+        self._replicas = []
+        self._running = False
+        self._probe = None
+        self._gen = 0
+        # fleet counters (under _lock)
+        self._routed = 0
+        self._served = 0
+        self._redispatched = 0
+        self._shed = 0
+        self._failed = 0
+        self._drains = 0
+        self._replaces = 0
+        self._last_drain_s = None
+        self._replacement_retraces = []   # fresh traces per replacement
+        _register_router(self)
+        from ..telemetry import registry as treg
+        fid = self.telemetry_id
+        self._c_routed = treg.counter(f"fleet::{fid}::routed")
+        self._c_redis = treg.counter(f"fleet::{fid}::redispatched")
+        self._c_shed = treg.counter(f"fleet::{fid}::shed")
+        self._g_shed_rate = treg.gauge("fleet::shed_rate")
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self):
+        """Build + warm every replica, then start the health-probe
+        thread. Warmup happens replica by replica so a shared compile
+        cache turns all but the first into AOT loads."""
+        with self._lock:
+            if self._running:
+                return self
+            for slot in range(self._n):
+                self._replicas.append(self._spawn(slot))
+            self._running = True
+        self._probe = threading.Thread(target=self._probe_loop,
+                                       name=f"{self.name}-probe",
+                                       daemon=True)
+        self._probe.start()
+        return self
+
+    def stop(self, drain=True):
+        """Stop probing and every replica (``drain=True`` serves queued
+        work first, per replica)."""
+        with self._lock:
+            if not self._running:
+                return
+            self._running = False
+            replicas = list(self._replicas)
+        if self._probe is not None:
+            self._probe.join(timeout=self.probe_interval_s * 4 + 5)
+            self._probe = None
+        for r in replicas:
+            try:
+                r.batcher.stop(drain=drain)
+            except Exception:            # noqa: BLE001
+                pass
+            r.state = DEAD
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _spawn(self, slot):
+        """Factory + warmup for one replica slot (replacements reuse
+        this; the warmup retrace count is the AOT-spin-up pin)."""
+        batcher = self._factory()
+        batcher.start()
+        rep = _Replica(slot, batcher, generation=self._gen)
+        rep.state = HEALTHY
+        return rep
+
+    # -- client surface -------------------------------------------------------
+    def submit(self, data, deadline_ms=None, **kw):
+        """Route one request to the least-loaded healthy replica;
+        returns the future (a ``ServingFuture``, or the replica's
+        ``StreamFuture`` for decode fleets). Raises fleet-level
+        ``Overloaded`` only when EVERY healthy replica sheds."""
+        deadline = time.perf_counter() + deadline_ms / 1e3 \
+            if deadline_ms is not None else None
+        with self._lock:
+            if not self._running:
+                raise MXNetError(f"FleetRouter '{self.name}' is not "
+                                 "started")
+            self._routed += 1
+        self._c_routed.inc()
+        fut = self._dispatch(data, deadline, deadline_ms, kw, attempt=0,
+                             outer=None, t0=time.perf_counter())
+        if fut is None:
+            self._note_shed()
+            raise Overloaded(
+                f"fleet '{self.name}': every healthy replica is at its "
+                "queue bound; shedding — retry with backoff")
+        return fut
+
+    def predict(self, data, deadline_ms=None, timeout=None, **kw):
+        """Blocking convenience: ``submit(...).result(...)``."""
+        return self.submit(data, deadline_ms=deadline_ms,
+                           **kw).result(timeout)
+
+    # -- dispatch / re-dispatch ----------------------------------------------
+    def _candidates(self):
+        with self._lock:
+            reps = [r for r in self._replicas if r.state == HEALTHY]
+        return sorted(reps, key=lambda r: r.queue_depth())
+
+    def _dispatch(self, data, deadline, deadline_ms, kw, attempt, outer,
+                  t0):
+        """Try healthy replicas in least-loaded order. Returns the
+        client-facing future, or None when every replica shed (the
+        caller decides between fleet Overloaded and completing
+        ``outer``)."""
+        remaining_ms = deadline_ms
+        if deadline is not None:
+            remaining_ms = max(0.0,
+                               (deadline - time.perf_counter()) * 1e3)
+        for rep in self._candidates():
+            try:
+                inner = rep.batcher.submit(data,
+                                           deadline_ms=remaining_ms,
+                                           **kw)
+            except Overloaded:
+                continue                  # replica-level shed: next one
+            except MXNetError as e:
+                if "is not started" in str(e):
+                    continue              # lost a race with a drain
+                raise                     # request-contract error
+            self._emit_route(rep, inner, attempt)
+            if not isinstance(inner, ServingFuture):
+                # streaming (decode) future: route-only — health
+                # accounting via the done-callback, no replay of a
+                # stream that may already have delivered tokens
+                inner.add_done_callback(
+                    lambda f, rep=rep, t0=t0:
+                    self._note_stream_done(rep, f, t0))
+                return inner
+            if outer is None:
+                outer = ServingFuture()
+            if outer.trace_id is None:
+                outer.trace_id = inner.trace_id
+            inner.add_done_callback(
+                lambda f, rep=rep: self._on_done(
+                    rep, f, outer, data, deadline, deadline_ms, kw,
+                    attempt, t0))
+            return outer
+        return None
+
+    def _on_done(self, rep, inner, outer, data, deadline, deadline_ms,
+                 kw, attempt, t0):
+        """Completion handler for one replica-level future: surface the
+        result, or classify the error and transparently re-dispatch."""
+        err = inner._error
+        if err is None:
+            now = time.perf_counter()
+            with self._lock:
+                rep.consec_failures = 0
+                rep.served += 1
+                rep.lats.append(now - t0)
+                if len(rep.lats) > self._lat_window:
+                    del rep.lats[:len(rep.lats) - self._lat_window]
+                self._served += 1
+            self._finish(outer, result=inner._result, t0=t0)
+            return
+        if isinstance(err, DeadlineExceeded):
+            # the REQUEST ran out of budget, not the replica
+            self._finish(outer, error=err, t0=t0)
+            return
+        redispatchable = True
+        if isinstance(err, Overloaded):
+            # queued work shed by a drain — re-route, no health penalty
+            pass
+        else:
+            redispatchable = self._note_failure(rep, err)
+        if redispatchable and attempt < self.max_redispatch and \
+                (deadline is None or time.perf_counter() < deadline):
+            with self._lock:
+                self._redispatched += 1
+                rep.redispatched_away += 1
+            self._c_redis.inc()
+            self._emit_redispatch(rep, outer, attempt, err)
+            fut = self._dispatch(data, deadline, deadline_ms, kw,
+                                 attempt + 1, outer, t0)
+            if fut is not None:
+                return
+            self._note_shed()
+            err = Overloaded(
+                f"fleet '{self.name}': no healthy replica to "
+                f"re-dispatch to after {type(err).__name__}")
+        self._finish(outer, error=err, t0=t0)
+
+    def _note_stream_done(self, rep, fut, t0):
+        err = fut._error
+        from . import Cancelled
+        now = time.perf_counter()
+        with self._lock:
+            if err is None:
+                rep.consec_failures = 0
+                rep.served += 1
+                rep.lats.append(now - t0)
+                if len(rep.lats) > self._lat_window:
+                    del rep.lats[:len(rep.lats) - self._lat_window]
+                self._served += 1
+                return
+        if not isinstance(err, (DeadlineExceeded, Cancelled,
+                                Overloaded)):
+            self._note_failure(rep, err)
+
+    def _note_failure(self, rep, err):
+        """Replica-health ledger: consecutive program failures (or a
+        permanent fault flag) condemn the replica. Returns whether the
+        request should be re-dispatched."""
+        with self._lock:
+            self._failed += 1
+            rep.consec_failures += 1
+            condemned = rep.consec_failures >= self.max_failures or \
+                getattr(rep.predictor, "_faulted", False)
+            if condemned and rep.state == HEALTHY:
+                rep.state = DEAD
+        return True
+
+    def _finish(self, outer, result=None, error=None, t0=None):
+        if outer is None:
+            return
+        outer._complete(result=result, error=error)
+        if t0 is not None and _trace.enabled():
+            _trace.record_span(
+                "fleet:request", "serving", t0,
+                time.perf_counter() - t0, trace_id=outer.trace_id,
+                args={"router": self.telemetry_id,
+                      "error": type(error).__name__ if error else None})
+
+    def _note_shed(self):
+        with self._lock:
+            self._shed += 1
+            shed, routed = self._shed, self._routed
+        self._c_shed.inc()
+        self._g_shed_rate.set(shed / max(1, routed))
+        from ..telemetry import export as _texp
+        if _texp.enabled():
+            _texp.emit_event("fleet_shed", router=self.telemetry_id)
+
+    def _emit_route(self, rep, inner, attempt):
+        from ..telemetry import export as _texp
+        if _texp.enabled():
+            _texp.emit_event(
+                "fleet_route", router=self.telemetry_id,
+                replica=rep.predictor.telemetry_id, slot=rep.slot,
+                trace_id=getattr(inner, "trace_id", None),
+                attempt=attempt)
+
+    def _emit_redispatch(self, rep, outer, attempt, err):
+        from ..telemetry import export as _texp
+        if _texp.enabled():
+            _texp.emit_event(
+                "fleet_redispatch", router=self.telemetry_id,
+                from_replica=rep.predictor.telemetry_id,
+                trace_id=getattr(outer, "trace_id", None),
+                attempt=attempt, error=type(err).__name__)
+
+    # -- health probing / drain / replace -------------------------------------
+    def _probe_loop(self):
+        while True:
+            with self._lock:
+                if not self._running:
+                    return
+            try:
+                self._probe_once()
+            except Exception:            # noqa: BLE001 — probing must survive
+                import logging
+                logging.getLogger("mxnet_tpu.serving").exception(
+                    "fleet health probe failed")
+            time.sleep(self.probe_interval_s)
+
+    def _probe_once(self):
+        """One health pass: condemn faulted replicas, drain the worst
+        straggler, replace the dead."""
+        with self._lock:
+            reps = list(self._replicas)
+        for rep in reps:
+            if rep.state == HEALTHY and \
+                    getattr(rep.predictor, "_faulted", False):
+                with self._lock:
+                    if rep.state == HEALTHY:
+                        rep.state = DEAD
+        straggler = self._find_straggler()
+        if straggler is not None:
+            self._drain(straggler, polite=True)
+        for rep in reps:
+            if rep.state == DEAD:
+                self._drain(rep, polite=False)
+                self._replace(rep)
+
+    def _find_straggler(self):
+        with self._lock:
+            healthy = [r for r in self._replicas
+                       if r.state == HEALTHY
+                       and len(r.lats) >= self._min_lat_samples]
+            if len(healthy) < 2:
+                return None
+            meds = {r: _median(r.lats) for r in healthy}
+        fleet_med = _median(list(meds.values()))
+        if not fleet_med:
+            return None
+        worst = max(meds, key=meds.get)
+        if meds[worst] >= self.straggler_factor * fleet_med:
+            with self._lock:
+                worst.state = DRAINING
+            return worst
+        return None
+
+    def _drain(self, rep, polite):
+        """Retire one replica. ``polite=True`` (straggler) serves its
+        queue first; ``polite=False`` (dead) sheds the queue — the shed
+        futures' done-callbacks re-dispatch every queued request to the
+        healthy replicas, so nothing is dropped either way."""
+        t0 = time.perf_counter()
+        with self._lock:
+            if rep.state not in (DRAINING, DEAD):
+                return
+            was = rep.state
+            rep.state = DRAINING if polite else DEAD
+            self._drains += 1
+        try:
+            rep.batcher.stop(drain=polite)
+        except Exception:                # noqa: BLE001
+            pass
+        with self._lock:
+            rep.state = DEAD
+            self._last_drain_s = time.perf_counter() - t0
+        from ..telemetry import export as _texp
+        if _texp.enabled():
+            _texp.emit_event(
+                "fleet_drain", router=self.telemetry_id,
+                replica=rep.predictor.telemetry_id, slot=rep.slot,
+                polite=polite, was=was,
+                drain_s=round(self._last_drain_s, 6))
+
+    def _replace(self, rep):
+        """Spin up a replacement in a dead slot (AOT warm-start from
+        the shared compile cache: the retrace count is recorded and the
+        chaos drill pins it at 0)."""
+        with self._lock:
+            if not self._running or self._replicas[rep.slot] is not rep:
+                return
+            self._gen += 1
+            gen = self._gen
+        try:
+            fresh = self._spawn(rep.slot)
+        except Exception:                # noqa: BLE001 — retry next probe
+            import logging
+            logging.getLogger("mxnet_tpu.serving").exception(
+                "fleet replica replacement failed (slot %d)", rep.slot)
+            return
+        fresh.generation = gen
+        with self._lock:
+            self._replicas[rep.slot] = fresh
+            self._replaces += 1
+            self._replacement_retraces.append(fresh.predictor.retraces)
+        from ..telemetry import export as _texp
+        if _texp.enabled():
+            _texp.emit_event(
+                "fleet_replace", router=self.telemetry_id,
+                slot=rep.slot, generation=gen,
+                replica=fresh.predictor.telemetry_id,
+                retraces=fresh.predictor.retraces,
+                cache_loads=fresh.predictor._cache_loads)
+
+    def drain_slot(self, slot):
+        """Operator surface (planned maintenance, bench drills):
+        politely drain the replica in ``slot`` — its queue is served,
+        then it retires and the probe loop spins up the replacement.
+        Returns the drain latency in seconds."""
+        with self._lock:
+            rep = self._replicas[slot]
+            if rep.state != HEALTHY:
+                raise MXNetError(
+                    f"fleet slot {slot} is {rep.state}, not healthy")
+            rep.state = DRAINING
+        self._drain(rep, polite=True)
+        return self._last_drain_s
+
+    # -- observability --------------------------------------------------------
+    @property
+    def queue_depth(self):
+        """Total queued rows across live replicas."""
+        return sum(r.queue_depth() for r in self._candidates())
+
+    def replica_states(self):
+        with self._lock:
+            return {r.slot: r.state for r in self._replicas}
+
+    def report(self, reset=False):
+        with self._lock:
+            per_replica = []
+            for r in self._replicas:
+                med = _median(r.lats)
+                per_replica.append({
+                    "slot": r.slot,
+                    "id": r.predictor.telemetry_id,
+                    "state": r.state,
+                    "generation": r.generation,
+                    "served": r.served,
+                    "consec_failures": r.consec_failures,
+                    "redispatched_away": r.redispatched_away,
+                    "p50_ms": round(med * 1e3, 3) if med else None,
+                    "queue_depth": r.queue_depth(),
+                    "retraces": r.predictor.retraces,
+                })
+            out = {
+                "id": self.telemetry_id,
+                "name": self.name,
+                "replicas": per_replica,
+                "routed": self._routed,
+                "served": self._served,
+                "redispatched": self._redispatched,
+                "shed": self._shed,
+                "failed": self._failed,
+                "shed_rate": self._shed / max(1, self._routed),
+                "drains": self._drains,
+                "replaces": self._replaces,
+                "last_drain_s": self._last_drain_s,
+                "replacement_retraces": list(self._replacement_retraces),
+            }
+            if reset:
+                self._routed = self._served = 0
+                self._redispatched = self._shed = self._failed = 0
+                self._drains = self._replaces = 0
+                self._replacement_retraces = []
+        return out

@@ -7,36 +7,134 @@ test — the measurement methodology (barrier start, per-request latency
 under a lock, wall-clock window from barrier release to last join)
 must not fork across the three, or their ``batcher_efficiency``
 numbers stop being comparable.
+
+Clients are also where RETRY policy lives (round 17): a server that
+sheds with ``Overloaded`` is telling the client "back off and come
+back", and the correct client answer is deadline-aware jittered
+exponential backoff — never a tight retry storm (which re-creates the
+overload it is escaping), never a sleep past the request's own
+deadline (which turns a shed into a timeout). Both closed-loop
+harnesses implement the policy behind ``retries=``/``backoff_ms=``;
+retried requests are counted separately from server-side sheds (a
+retry the server absorbed is load smoothing; a give-up is lost work)
+and surface in the ``clients`` section of
+``mxnet_tpu.serving.serving_report()``.
 """
 from __future__ import annotations
 
+import random
 import threading
 import time
 
 import numpy as np
 
-__all__ = ["closed_loop", "raw_predict_rate", "token_closed_loop"]
+from . import Overloaded
+
+__all__ = ["closed_loop", "raw_predict_rate", "token_closed_loop",
+           "client_report"]
+
+# client-side retry ledger (process-wide; serving_report()'s "clients"
+# section reads it, reset=True starts a fresh window)
+_client_lock = threading.Lock()
+_retries = 0      # Overloaded submissions retried after backoff
+_gave_up = 0      # Overloaded submissions abandoned (budget/deadline)
 
 
-def closed_loop(batcher, x_req, clients, per_client, timeout=300):
+def client_report(reset: bool = False) -> dict:
+    global _retries, _gave_up
+    with _client_lock:
+        out = {"retries": _retries, "gave_up": _gave_up}
+        if reset:
+            _retries = _gave_up = 0
+    return out
+
+
+def _note_retry():
+    global _retries
+    with _client_lock:
+        _retries += 1
+
+
+def _note_give_up():
+    global _gave_up
+    with _client_lock:
+        _gave_up += 1
+
+
+def _backoff_s(attempt, backoff_ms, jitter):
+    """Jittered exponential backoff: base * 2^attempt, multiplied by a
+    uniform draw from [1-jitter, 1+jitter] so retry waves decorrelate."""
+    base = (backoff_ms / 1e3) * (2 ** attempt)
+    return base * random.uniform(1.0 - jitter, 1.0 + jitter)
+
+
+def _call_with_retry(fn, deadline, retries, backoff_ms, jitter):
+    """Run ``fn()`` retrying ONLY on ``Overloaded``, sleeping the
+    jittered exponential backoff between attempts, never sleeping past
+    ``deadline`` (a perf_counter timestamp, or None). Re-raises the
+    last ``Overloaded`` once the retry budget or the deadline is
+    exhausted."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Overloaded:
+            if attempt >= retries:
+                _note_give_up()
+                raise
+            wait = _backoff_s(attempt, backoff_ms, jitter)
+            if deadline is not None:
+                room = deadline - time.perf_counter()
+                if room <= 0:
+                    _note_give_up()
+                    raise
+                wait = min(wait, room)
+            _note_retry()
+            time.sleep(wait)
+            attempt += 1
+
+
+def closed_loop(batcher, x_req, clients, per_client, timeout=300,
+                deadline_ms=None, retries=0, backoff_ms=25, jitter=0.5):
     """Drive ``clients`` closed-loop threads, each submitting ``x_req``
     (one request of ``x_req.shape[0]`` rows) ``per_client`` times
     through ``batcher.predict``. Returns a dict with rows/s and
-    client-observed latency percentiles."""
+    client-observed latency percentiles.
+
+    ``retries`` > 0 arms the deadline-aware retry policy: an
+    ``Overloaded`` rejection is retried after jittered exponential
+    backoff (``backoff_ms`` base, doubled per attempt, scaled by a
+    uniform ``1 ± jitter`` draw), at most ``retries`` times and never
+    sleeping past the request's ``deadline_ms``. A request that
+    exhausts the budget counts as a client give-up and its latency is
+    excluded (it produced no answer). ``deadline_ms`` is also passed
+    through to the server when the batcher accepts it."""
     rows = x_req.shape[0] if hasattr(x_req, "shape") else 1
     lats = []
+    failed = [0]
     lock = threading.Lock()
     barrier = threading.Barrier(clients + 1)
+    kw = {"deadline_ms": deadline_ms} if deadline_ms is not None else {}
 
     def client():
         barrier.wait()
-        mine = []
+        mine, mine_failed = [], 0
         for _ in range(per_client):
             t_r = time.perf_counter()
-            batcher.predict(x_req, timeout=timeout)
+            deadline = t_r + deadline_ms / 1e3 \
+                if deadline_ms is not None else None
+            try:
+                _call_with_retry(
+                    lambda: batcher.predict(x_req, timeout=timeout,
+                                            **kw),
+                    deadline, retries, backoff_ms, jitter)
+            except Overloaded:
+                mine_failed += 1
+                continue
             mine.append(time.perf_counter() - t_r)
         with lock:
             lats.extend(mine)
+            failed[0] += mine_failed
 
     threads = [threading.Thread(target=client) for _ in range(clients)]
     for t in threads:
@@ -47,37 +145,55 @@ def closed_loop(batcher, x_req, clients, per_client, timeout=300):
         t.join()
     dt = time.perf_counter() - t0
     n_reqs = clients * per_client
+    n_ok = len(lats)
     return {
-        "rows_s": n_reqs * rows / dt,
-        "req_s": n_reqs / dt,
-        "p50_ms": float(np.percentile(lats, 50)) * 1e3,
-        "p99_ms": float(np.percentile(lats, 99)) * 1e3,
+        "rows_s": n_ok * rows / dt,
+        "req_s": n_ok / dt,
+        "p50_ms": float(np.percentile(lats, 50)) * 1e3 if lats else None,
+        "p99_ms": float(np.percentile(lats, 99)) * 1e3 if lats else None,
         "wall_s": dt,
+        "submitted": n_reqs,
+        "completed": n_ok,
+        "gave_up": failed[0],
     }
 
 
 def token_closed_loop(batcher, prompts, clients, per_client,
-                      max_new_tokens=8, timeout=300):
+                      max_new_tokens=8, timeout=300, deadline_ms=None,
+                      retries=0, backoff_ms=25, jitter=0.5):
     """Token-granularity twin of :func:`closed_loop` for a
     ``DecodeBatcher``: each client thread submits a prompt (drawn
     round-robin from ``prompts``), ITERATES the returned stream, and
     records time-to-first-token plus every inter-token gap. Returns
     tokens/s and the two SLO percentile families (TTFT, inter-token)
-    the decode autotuning objective is built from."""
+    the decode autotuning objective is built from. The same
+    ``retries``/``backoff_ms``/``jitter`` admission-retry policy as
+    :func:`closed_loop` applies to the submit call (``Overloaded``
+    only — a stream that already produced tokens is never replayed)."""
     ttfts, itls = [], []
     tokens = [0]
+    failed = [0]
     lock = threading.Lock()
     barrier = threading.Barrier(clients + 1)
 
     def client(cid):
         barrier.wait()
-        my_ttft, my_itl, my_toks = [], [], 0
+        my_ttft, my_itl, my_toks, my_failed = [], [], 0, 0
         for i in range(per_client):
             prompt = prompts[(cid + i * clients) % len(prompts)]
             t_r = time.perf_counter()
+            deadline = t_r + deadline_ms / 1e3 \
+                if deadline_ms is not None else None
+            try:
+                stream = _call_with_retry(
+                    lambda: batcher.submit(
+                        prompt, max_new_tokens=max_new_tokens),
+                    deadline, retries, backoff_ms, jitter)
+            except Overloaded:
+                my_failed += 1
+                continue
             t_last = None
-            for _ in batcher.submit(prompt,
-                                    max_new_tokens=max_new_tokens):
+            for _ in stream:
                 now = time.perf_counter()
                 if t_last is None:
                     my_ttft.append(now - t_r)
@@ -89,6 +205,7 @@ def token_closed_loop(batcher, prompts, clients, per_client,
             ttfts.extend(my_ttft)
             itls.extend(my_itl)
             tokens[0] += my_toks
+            failed[0] += my_failed
 
     threads = [threading.Thread(target=client, args=(c,))
                for c in range(clients)]
@@ -113,6 +230,7 @@ def token_closed_loop(batcher, prompts, clients, per_client,
         "inter_token_p99_ms": _pct(itls, 99),
         "tokens": tokens[0],
         "wall_s": dt,
+        "gave_up": failed[0],
     }
 
 

@@ -268,6 +268,7 @@ class Predictor:
         self._program_memory = {}  # (bucket, dtypes) -> memory dict
         self._materialized = 0  # fresh traces taken BY this instance
         self._cache_loads = 0   # bucket programs AOT-loaded from disk
+        self._faulted = False   # replica_drop fired: permanently dead
         self._lock = threading.Lock()
         # per-bucket counters: calls, rows served, pad rows wasted
         self._bucket_calls = {b: 0 for b in self.buckets}
@@ -413,6 +414,23 @@ class Predictor:
         """Pad name-ordered request arrays to ``bucket`` rows and run
         the compiled program. Returns trimmed numpy outputs."""
         import jax.numpy as jnp
+        from .. import faultinject
+        # ``replica_drop``: the serving-replica loss drill. ``call=N``
+        # (or ``replica=<telemetry id>``) picks the victim micro-batch;
+        # ``action=kill`` SIGKILLs the process, ``action=sleep:ms=N``
+        # stretches the batch (the straggler-replica drill), and a
+        # plain raise marks THIS replica permanently dead — an
+        # in-process stand-in for a killed replica the FleetRouter must
+        # drain and replace without dropping a request.
+        if faultinject.fire("replica_drop", replica=self.telemetry_id):
+            if (faultinject.active("replica_drop") or
+                    {}).get("action") != "sleep":
+                self._faulted = True
+                raise faultinject.FaultInjected(
+                    "replica_drop", replica=self.telemetry_id)
+        if self._faulted:
+            raise MXNetError(
+                f"predictor {self.telemetry_id} is dead (replica_drop)")
         padded = []
         for a in arrays:
             if rows != bucket:
@@ -507,6 +525,7 @@ class Predictor:
                 "buckets": list(self.buckets),
                 "retraces": self._materialized,
                 "compile_cache_loads": self._cache_loads,
+                "faulted": self._faulted,
                 "per_bucket": {
                     b: {"calls": self._bucket_calls[b],
                         "rows": self._bucket_rows[b],

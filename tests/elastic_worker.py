@@ -1,0 +1,136 @@
+"""One rank of the elastic-training drill (parallel/elastic.py).
+
+Launched by an ``ElasticSupervisor`` (directly in tests/test_fleet.py,
+or via ``tools/chaos_drill.py --scenario dist_drop|heartbeat_miss``):
+reads its identity from the env the supervisor exports
+(``COORDINATOR_ADDRESS`` / ``NUM_PROCESSES`` / ``PROCESS_ID`` /
+``MXTPU_ELASTIC_GENERATION``), trains a tiny MLP data-parallel over the
+``dist_sync`` kvstore with per-epoch elastic checkpoints, and exits:
+
+- 0 when training completed its epochs;
+- ``REFORM_EXIT`` (75) when a peer was lost (heartbeat lease went
+  stale, or a collective died on the dead rank within the
+  ``MXTPU_FT_DIST_DEADLINE``) — the ask for a supervisor relaunch at
+  the new world size;
+- killed outright when this rank is the armed ``dist_drop`` victim.
+
+Faults (``MXTPU_FAULT_INJECT``) arm GENERATION 0 only: a relaunched
+generation drops the spec — the drill's failed machine stays failed,
+the recovered fleet is healthy. Determinism: the global dataset is
+fixed-seed; every generation re-shards it ``x_all[rank::world]``, so
+resuming at the same world size replays the identical schedule
+(bit-exact params, which the drill pins byte-for-byte), while a
+shrunken world re-shards and is compared to a shrunk-from-start oracle
+on final accuracy instead.
+
+Usage: elastic_worker.py <workdir> <num_epoch> [--rows N] [--batch B]
+"""
+import argparse
+import logging
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, os.pardir))
+
+rank = int(os.environ.get("PROCESS_ID", "0"))
+world = int(os.environ.get("NUM_PROCESSES", "1"))
+gen = int(os.environ.get("MXTPU_ELASTIC_GENERATION", "0"))
+coordinator = os.environ.get("COORDINATOR_ADDRESS")
+
+if gen > 0:
+    # faults drill generation 0; the relaunched fleet is healthy
+    os.environ.pop("MXTPU_FAULT_INJECT", None)
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ.pop("JAX_PLATFORMS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+if coordinator and world > 1:
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=world, process_id=rank)
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.parallel import dist, elastic  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("workdir")
+    ap.add_argument("num_epoch", type=int)
+    ap.add_argument("--rows", type=int, default=48)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stdout,
+                        force=True)
+    r, w = dist.process_identity()
+    assert (r, w) == (rank, world), (r, w, rank, world)
+
+    # fixed-seed GLOBAL dataset, deterministically sharded per rank —
+    # a re-formed generation recomputes its shard from (rank, world)
+    rng = np.random.RandomState(42)
+    x_all = rng.rand(args.rows, 8).astype(np.float32)
+    y_all = (x_all.sum(axis=1) * 2).astype(np.int64) % 4
+    x, y = x_all[rank::world], y_all[rank::world]
+    it = mx.io.NDArrayIter(x, y.astype(np.float32),
+                           batch_size=args.batch)
+
+    mx.random.seed(7)   # same init on every rank and every generation
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(context=mx.cpu(rank if world > 1 else 0),
+                        symbol=net)
+
+    manager = elastic.ElasticCheckpointManager(
+        os.path.join(args.workdir, "ck", f"rank-{rank}"),
+        generation=gen, async_save=False)
+    elastic.prepare_resume(manager, it)
+
+    with elastic.ElasticGuard(generation=gen) as guard:
+        try:
+            mod.fit(it, num_epoch=args.num_epoch,
+                    kvstore="dist_sync" if world > 1 else "local",
+                    optimizer="sgd",
+                    optimizer_params={"learning_rate": 0.1},
+                    initializer=mx.init.Xavier(),
+                    batch_end_callback=guard.batch_end_callback,
+                    checkpoint_manager=manager, auto_resume=True)
+        except Exception as e:                 # noqa: BLE001
+            if guard.should_reform(e):
+                print(f"rank {rank}: peer loss detected "
+                      f"({type(e).__name__}: {e}) — requesting "
+                      "re-form", flush=True)
+                # os._exit, not sys.exit: jax.distributed's atexit
+                # shutdown barrier would block on the dead peer for
+                # minutes and then SIGABRT this survivor
+                elastic.exit_for_reform()
+            raise
+
+    # byte-exact fingerprint of the final params: the unchanged-world
+    # resume drill compares this file against the never-killed oracle
+    arg_params, aux_params = mod.get_params()
+    blob = {k: v.asnumpy() for k, v in sorted(arg_params.items())}
+    blob.update({k: v.asnumpy() for k, v in sorted(aux_params.items())})
+    np.savez(os.path.join(args.workdir,
+                          f"final_g{gen}_r{rank}_w{world}.npz"), **blob)
+
+    # score on the GLOBAL dataset (not this rank's shard): the shrink
+    # drill compares accuracy across different world sizes, so the
+    # metric must not depend on the sharding
+    full = mx.io.NDArrayIter(x_all, y_all.astype(np.float32),
+                             batch_size=args.batch)
+    acc = mod.score(full, "acc")[0][1]
+    with open(os.path.join(args.workdir, f"acc_r{rank}"), "w") as f:
+        f.write(str(acc))
+    print(f"rank {rank}/{world} gen {gen}: done, acc {acc:.3f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -307,3 +307,35 @@ def test_trace_memory_suite_stays_tier1_with_chaos_marked():
         "the 4-process fleet straggler drill (slow_step faultinject) "
         "must carry pytest.mark.chaos like the other fault-injection "
         "suites")
+
+
+def test_fleet_suite_stays_tier1_with_chaos_marked():
+    """The fleet suite is tier-1's only proof that a replica kill under
+    load drops ZERO requests, that replacements AOT-load from the
+    compile cache (0 fresh traces), and that an elastic re-form resumes
+    training BIT-EXACT instead of silently retraining. It must (a)
+    exist, (b) carry ``serving`` marks on the router half so
+    ``-m serving`` selects the whole serving subsystem, (c) never carry
+    a ``slow`` mark that would drop those pins from the gate, and (d)
+    be ``chaos``-marked module-wide — every case is a deterministic
+    faultinject drill."""
+    path = os.path.join(_TESTS, "test_fleet.py")
+    assert os.path.exists(path), "tests/test_fleet.py missing"
+    with open(path) as f:
+        src = f.read()
+    m = re.search(r"^pytestmark\s*=.*$", src, re.M)
+    assert m is not None and "chaos" in m.group(0), (
+        "test_fleet.py must be chaos-marked module-wide: every case is "
+        "a deterministic fault-injection drill")
+    assert "slow" not in (m.group(0) if m else ""), (
+        "test_fleet.py must stay tier-1: the zero-drop, AOT-"
+        "replacement, and bit-exact-resume pins are round-17 "
+        "acceptance criteria")
+    uses = _mark_uses()
+    assert "test_fleet.py" in uses.get("serving", set()), (
+        "the FleetRouter half of test_fleet.py must carry "
+        "pytest.mark.serving so '-m serving' selects the whole "
+        "serving subsystem")
+    assert "test_fleet.py" not in uses.get("slow", set()), (
+        "test_fleet.py cases must not be slow-marked — the fleet "
+        "robustness pins are round-17 acceptance criteria")

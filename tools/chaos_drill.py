@@ -1,32 +1,65 @@
 #!/usr/bin/env python
-"""Chaos drill: prove a training job's checkpointing survives real kills.
+"""Chaos drills: prove the fault-tolerance story survives real kills.
 
-Runs a small training job under the CheckpointManager, murders it with a
-deterministically-injected fault (SIGKILL at byte N of a checkpoint
-write, by default), then restarts it with ``auto_resume`` and verifies it
-finishes — the operational fire drill for the fault-tolerance layer
-(docs/faq/failure_recovery.md). Exit code 0 means the recovery story
-holds end to end on THIS machine/filesystem.
+Four runnable fire-drill scenarios (``--scenario``), each a
+deterministically-injected fault (mxnet_tpu/faultinject.py) plus the
+recovery assertion that makes it a drill rather than a demo:
+
+``ckpt`` (default)
+    Murder a training job at byte N of a checkpoint write, optionally
+    bit-rot the newest checkpoint, then ``auto_resume`` and verify the
+    job finishes (the original r6 drill; CI twin:
+    tests/test_failure_resume.py).
+
+``replica_drop``
+    Serving-fleet drill: N batcher replicas behind the self-healing
+    FleetRouter (serving/fleet.py), closed-loop clients driving it,
+    one replica poisoned mid-load. PASS requires ZERO dropped
+    requests (every submit completed; shed->redispatch is invisible to
+    clients), the dead replica drained + replaced, and the
+    replacement spun up with 0 fresh XLA compiles (AOT-loaded from the
+    shared MXTPU_COMPILE_CACHE_DIR).
+
+``heartbeat_miss``
+    Elastic-training drill, the FALSE-POSITIVE case: one rank's lease
+    renewals are suppressed (the rank is healthy — its heartbeats just
+    stop arriving). Peers declare it lost, every rank exits
+    REFORM_EXIT, and the supervisor re-forms at the SAME world size;
+    the re-formed generation resumes from checkpoints and finishes.
+
+``dist_drop``
+    Elastic-training drill, the REAL-KILL case: SIGKILL one rank
+    mid-allreduce. Survivors detect the loss (collective deadline +
+    stale lease), exit REFORM_EXIT, the supervisor re-forms, and a
+    ``--rejoin`` generation brings the lost host back. PASS requires
+    every re-formed rank to resume from the newest checkpoint
+    (completed epochs never re-run) and the final params to be
+    bit-identical across ranks.
 
 Usage:
-    python tools/chaos_drill.py [--workdir D] [--epochs N]
-        [--fault SPEC]       # default: SIGKILL mid-write of ckpt 3
-        [--corrupt]          # additionally bit-rot the newest ckpt
-                             # between kill and resume
+    python tools/chaos_drill.py [--scenario S] [--workdir D]
+        [--epochs N] [--fault SPEC] [--corrupt]   # ckpt knobs
+        [--replicas N]                            # replica_drop
+        [--world N] [--no-rejoin]                 # dist_drop
 
-The same drill (fixed spec, assertions) runs in CI as
-tests/test_failure_resume.py; this CLI exists to run it against real
-storage (NFS, FUSE, network disks) where rename/fsync semantics — the
-ground the atomicity guarantee stands on — actually vary.
+The CLI exists to run these against real machines and real storage
+(NFS, FUSE, network disks) where the semantics the guarantees stand on
+actually vary; fixed-coordinate twins run in CI (tests/test_fleet.py,
+tests/test_failure_resume.py).
 """
 import argparse
 import os
 import subprocess
 import sys
 import tempfile
+import time
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_WORKER = os.path.join(_HERE, os.pardir, "tests", "resume_worker.py")
+sys.path.insert(0, os.path.join(_HERE, os.pardir))
+_RESUME_WORKER = os.path.join(_HERE, os.pardir, "tests",
+                              "resume_worker.py")
+_ELASTIC_WORKER = os.path.join(_HERE, os.pardir, "tests",
+                               "elastic_worker.py")
 
 
 def _run(args, fault=None):
@@ -34,24 +67,14 @@ def _run(args, fault=None):
            if k not in ("MXTPU_FAULT_INJECT",)}
     if fault:
         env["MXTPU_FAULT_INJECT"] = fault
-    p = subprocess.run([sys.executable, _WORKER] + args,
+    p = subprocess.run([sys.executable, _RESUME_WORKER] + args,
                        capture_output=True, text=True, env=env,
                        timeout=900)
     return p
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--workdir", default=None)
-    ap.add_argument("--epochs", type=int, default=4)
-    ap.add_argument("--fault",
-                    default="ckpt_write:byte=800:action=kill"
-                            ":match=params.params:call=3")
-    ap.add_argument("--corrupt", action="store_true")
-    args = ap.parse_args()
-
-    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_drill_")
-    os.makedirs(workdir, exist_ok=True)
+def drill_ckpt(args, workdir):
+    """SIGKILL mid-checkpoint-write -> (optional bit-rot) -> resume."""
     prefix = os.path.join(workdir, "job")
     ckdir = os.path.join(workdir, "ck")
 
@@ -99,6 +122,240 @@ def main():
     print(f"PASS: resumed run finished, final train acc {acc:.3f} "
           f"(checkpoints in {ckdir})")
     return 0 if acc > 0.9 else 1
+
+
+def drill_replica_drop(args, workdir):
+    """Poison one serving replica under closed-loop load; the fleet
+    must drop ZERO requests and respawn the replica from the compile
+    cache."""
+    os.environ["MXTPU_COMPILE_CACHE_DIR"] = os.path.join(workdir,
+                                                         "ccache")
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import faultinject, serving
+    from mxnet_tpu.serving import loadgen
+
+    feat = 16
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=32, name="cd_fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="cd_relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=10, name="cd_fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    mod = mx.mod.Module(context=mx.cpu(), symbol=net)
+    mod.bind(data_shapes=[("data", (8, feat))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+
+    def factory():
+        pred = mod.as_predictor(buckets=(2, 8))
+        return serving.DynamicBatcher(pred, max_wait_us=1000,
+                                      max_queue=4096, name="chaos")
+
+    x = np.random.RandomState(0).rand(2, feat).astype(np.float32)
+    router = serving.FleetRouter(factory, replicas=args.replicas,
+                                 name="chaos-fleet",
+                                 probe_interval_s=0.2)
+    router.start()
+    print(f"[1/3] fleet of {args.replicas} up; warming (populates "
+          "the shared compile cache)")
+    loadgen.closed_loop(router, x, clients=2, per_client=10)
+
+    victim = router._replicas[0].predictor.telemetry_id
+    print(f"[2/3] poisoning replica {victim!r} under load")
+    with faultinject.inject(replica_drop={"replica": victim}):
+        run = loadgen.closed_loop(router, x, clients=4, per_client=25,
+                                  retries=3, backoff_ms=10)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        rep = router.report()
+        if rep["replaces"] >= 1 and \
+                all(r["state"] == "healthy" for r in rep["replicas"]):
+            break
+        time.sleep(0.1)
+    rep = router.report()
+    router.stop()
+
+    print(f"[3/3] submitted={run['submitted']} "
+          f"completed={run['completed']} gave_up={run['gave_up']} "
+          f"redispatched={rep['redispatched']} "
+          f"replaces={rep['replaces']} "
+          f"replacement_retraces={rep['replacement_retraces']}")
+    ok = True
+    if run["completed"] != run["submitted"] or run["gave_up"]:
+        print("FAIL: requests were dropped — the fleet must complete "
+              "every submitted request across a replica kill")
+        ok = False
+    if rep["replaces"] < 1:
+        print("FAIL: the poisoned replica was never replaced")
+        ok = False
+    if any(n != 0 for n in rep["replacement_retraces"]):
+        print("FAIL: a replacement replica took fresh XLA compiles "
+              f"({rep['replacement_retraces']}) — it must AOT-load "
+              "from the shared compile cache")
+        ok = False
+    if ok:
+        print("PASS: zero dropped requests across replica kill + "
+              "drain + replacement (replacement compiles: 0)")
+    return 0 if ok else 1
+
+
+def _elastic_env():
+    env = dict(os.environ)
+    env.pop("MXTPU_FAULT_INJECT", None)
+    env.setdefault("MXTPU_FT_DIST_DEADLINE", "6")
+    env.setdefault("MXTPU_FLEET_HEARTBEAT_S", "0.2")
+    env.setdefault("MXTPU_FLEET_LEASE_S", "1.0")
+    return env
+
+
+def _print_history(history):
+    for h in history:
+        print(f"      gen {h['generation']}: world={h['world']} "
+              f"codes={h['codes']} lost={h['lost']} -> {h['outcome']}")
+
+
+def drill_heartbeat_miss(args, workdir):
+    """Suppress one healthy rank's lease renewals: every rank must ask
+    for a re-form (exit 75), and the next generation re-forms at the
+    SAME world size and finishes from checkpoints."""
+    from mxnet_tpu.parallel import elastic
+
+    world = args.world
+    env = _elastic_env()
+    env["MXTPU_COMPILE_CACHE_DIR"] = os.path.join(workdir, "ccache")
+
+    def argv_fn(rank, w, gen, coord):
+        # enough epochs that training outlasts the lease-loss
+        # detection window (the drill wants a MID-training re-form)
+        return [sys.executable, _ELASTIC_WORKER, workdir, "40"]
+
+    # rank 0 is the victim on purpose: it hosts the jax coordination
+    # service, so it must OUTLIVE its peers' REFORM_EXITs — peers
+    # detect rank 0's stale lease and leave first, then rank 0's next
+    # collective times out and it re-checks the leases itself
+    print(f"[1/2] world={world}; suppressing rank 0's heartbeats "
+          "(the rank itself is healthy)")
+    sup = elastic.ElasticSupervisor(
+        argv_fn, world=world, env=env, timeout_s=args.timeout,
+        fault="heartbeat_miss:rank=0:times=999", fault_rank=0)
+    history = sup.run()
+    _print_history(history)
+
+    print("[2/2] checking the re-form")
+    ok = True
+    if len(history) < 2 or history[0]["outcome"] != "reform":
+        print("FAIL: the stale lease never triggered a re-form")
+        ok = False
+    elif history[0]["lost"]:
+        print(f"FAIL: ranks {history[0]['lost']} counted as lost — a "
+              "heartbeat false positive must not kill processes")
+        ok = False
+    elif history[1]["world"] != world:
+        print(f"FAIL: world changed {world} -> "
+              f"{history[1]['world']}; a false positive must re-form "
+              "at the same size")
+        ok = False
+    if ok and history[-1]["outcome"] != "done":
+        print("FAIL: the re-formed generation did not finish")
+        ok = False
+    if ok:
+        print(f"PASS: false-positive lease loss -> whole-fleet "
+              f"re-form at world {world}, resumed from checkpoints "
+              "and finished")
+    return 0 if ok else 1
+
+
+def drill_dist_drop(args, workdir):
+    """SIGKILL one rank mid-allreduce; survivors re-form, the host
+    rejoins, every rank resumes from the newest checkpoint and the
+    finals are bit-identical across ranks."""
+    import glob
+
+    import numpy as np
+
+    from mxnet_tpu.parallel import elastic
+
+    world = args.world
+    env = _elastic_env()
+    env["MXTPU_COMPILE_CACHE_DIR"] = os.path.join(workdir, "ccache")
+
+    def argv_fn(rank, w, gen, coord):
+        return [sys.executable, _ELASTIC_WORKER, workdir, "3"]
+
+    rejoin = None if args.no_rejoin else {1: world}
+    print(f"[1/2] world={world}; SIGKILL rank 1 at allreduce #10"
+          + ("" if args.no_rejoin else f"; rejoin to {world} at gen 1"))
+    sup = elastic.ElasticSupervisor(
+        argv_fn, world=world, env=env, timeout_s=args.timeout,
+        fault="dist_drop:call=10:action=kill", fault_rank=1)
+    history = sup.run(rejoin=rejoin)
+    _print_history(history)
+
+    print("[2/2] checking recovery")
+    ok = True
+    if history[0]["outcome"] != "reform" or 1 not in history[0]["lost"]:
+        print("FAIL: the kill never triggered a re-form")
+        ok = False
+    if any(c not in (0, elastic.REFORM_EXIT, -9)
+           for c in history[0]["codes"]):
+        print(f"FAIL: a survivor crashed instead of requesting "
+              f"re-form (codes={history[0]['codes']})")
+        ok = False
+    if history[-1]["outcome"] != "done":
+        print("FAIL: the re-formed generation did not finish")
+        ok = False
+    if ok:
+        last = history[-1]
+        finals = sorted(glob.glob(os.path.join(
+            workdir, f"final_g{last['generation']}_r*.npz")))
+        blobs = [dict(np.load(f)) for f in finals]
+        for other in blobs[1:]:
+            for k in blobs[0]:
+                if blobs[0][k].tobytes() != other[k].tobytes():
+                    print(f"FAIL: final param {k!r} differs across "
+                          "ranks after recovery")
+                    ok = False
+        # the re-formed generation must CATCH UP, not start over:
+        # every rank's log shows the auto-resume from the pre-kill
+        # checkpoint (completed epochs never re-run)
+        for r, log in enumerate(last["logs"]):
+            if "Auto-resume from checkpoint" not in log:
+                print(f"FAIL: re-formed rank {r} trained from scratch "
+                      "instead of resuming the newest checkpoint")
+                ok = False
+    if ok:
+        print("PASS: rank killed mid-allreduce -> re-form -> rejoin; "
+              "every rank resumed from checkpoint, finals "
+              "bit-identical across ranks")
+    return 0 if ok else 1
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scenario", default="ckpt",
+                    choices=("ckpt", "replica_drop", "heartbeat_miss",
+                             "dist_drop"))
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--fault",
+                    default="ckpt_write:byte=800:action=kill"
+                            ":match=params.params:call=3")
+    ap.add_argument("--corrupt", action="store_true")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--no-rejoin", action="store_true")
+    ap.add_argument("--timeout", type=float, default=240)
+    args = ap.parse_args()
+
+    workdir = args.workdir or tempfile.mkdtemp(
+        prefix=f"chaos_{args.scenario}_")
+    os.makedirs(workdir, exist_ok=True)
+    drill = {"ckpt": drill_ckpt,
+             "replica_drop": drill_replica_drop,
+             "heartbeat_miss": drill_heartbeat_miss,
+             "dist_drop": drill_dist_drop}[args.scenario]
+    return drill(args, workdir)
 
 
 if __name__ == "__main__":

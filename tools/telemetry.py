@@ -9,7 +9,7 @@ operational surface:
     telemetry.py summary [--dir D] [--json]
     telemetry.py diff    A.json B.json [--json]
                          [--gate-bytes] [--gate-peak-mem]
-                         [--tolerance PCT]
+                         [--gate-shed-rate] [--tolerance PCT]
     telemetry.py render  [--dir D]
     telemetry.py fleet   [--dir D] [--json] [--straggler-factor F]
     telemetry.py trace   [PATH] [--dir D] [--json]
@@ -38,6 +38,15 @@ schema, and prints a per-category span summary — open the same file in
 --gate-peak-mem`` is the HBM sibling of ``--gate-bytes``: exit 2 when
 ``mem::process_peak_bytes`` grew beyond tolerance between snapshots.
 
+Round 17 (serving fleet): ``diff --gate-shed-rate`` exits 2 when the
+fraction of fleet-admitted requests shed (``fleet::shed_rate`` gauge,
+or a BENCH file's ``fleet_serving.shed_rate``) regressed — the serving
+twin of the straggler gate; and ``fleet`` additionally aggregates the
+FleetRouter's ``fleet_route`` / ``fleet_redispatch`` / ``fleet_shed`` /
+``fleet_drain`` / ``fleet_replace`` events into a per-replica routing
+table plus per-request timelines (a request's hops across replicas,
+keyed by its propagated trace id).
+
 Pure file-level operations: no accelerator backend is initialized.
 """
 from __future__ import annotations
@@ -52,6 +61,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 BYTES_METRIC = "step::bytes_accessed"
 PEAK_MEM_METRIC = "mem::process_peak_bytes"
+SHED_RATE_METRIC = "fleet::shed_rate"
 
 
 def _dir(args):
@@ -230,6 +240,27 @@ def _load_peak_mem(tree, path):
              "memory analyses")
 
 
+def _load_shed_rate(tree, path):
+    """Fleet shed rate (shed requests / routed requests) from a
+    snapshot (``fleet::shed_rate`` gauge) or a BENCH JSON (bench.py's
+    ``fleet_serving.shed_rate``). Zero is a meaningful reading — the
+    healthy fleet sheds nothing — so presence, not truthiness, decides."""
+    m = tree.get("metrics", {}).get(SHED_RATE_METRIC)
+    if isinstance(m, dict) and "value" in m:
+        return float(m["value"])
+    fs = tree.get("fleet_serving")
+    if isinstance(fs, dict) and "shed_rate" in fs:
+        return float(fs["shed_rate"])
+    t = tree.get("telemetry", {})
+    m = t.get("metrics", {}).get(SHED_RATE_METRIC) if isinstance(t, dict) \
+        else None
+    if isinstance(m, dict) and "value" in m:
+        return float(m["value"])
+    sys.exit(f"{path}: no {SHED_RATE_METRIC} metric (and no "
+             "fleet_serving.shed_rate field) — not a telemetry "
+             "snapshot/BENCH file, or the run served no fleet traffic")
+
+
 def _flat_values(tree):
     """metric -> comparable scalar for the metric-by-metric diff."""
     out = {}
@@ -288,6 +319,22 @@ def cmd_diff(args):
             "tolerance_pct": args.tolerance,
             "regressed": mem_failed,
         }
+    shed_failed = False
+    if args.gate_shed_rate:
+        old_s = _load_shed_rate(old_t, args.old)
+        new_s = _load_shed_rate(new_t, args.new)
+        tol = args.tolerance / 100.0
+        # relative tolerance against a zero baseline is meaningless —
+        # a healthy fleet sheds nothing, so ANY shedding regresses it
+        shed_failed = new_s > old_s * (1.0 + tol) + 1e-12
+        result["gate_shed_rate"] = {
+            "old_shed_rate": old_s,
+            "new_shed_rate": new_s,
+            "delta_pct": round((new_s / old_s - 1.0) * 100.0, 4)
+            if old_s else None,
+            "tolerance_pct": args.tolerance,
+            "regressed": shed_failed,
+        }
     if args.json:
         print(json.dumps(result, indent=1))
     else:
@@ -305,6 +352,11 @@ def cmd_diff(args):
                   f"{g['new_peak_bytes']:.6g} "
                   f"({g['delta_pct']:+.3f}%, tolerance "
                   f"{args.tolerance}%)")
+        if args.gate_shed_rate:
+            g = result["gate_shed_rate"]
+            print(f"shed rate: {g['old_shed_rate']:.6g} -> "
+                  f"{g['new_shed_rate']:.6g} (tolerance "
+                  f"{args.tolerance}%)")
     if gate_failed:
         print(f"BYTES REGRESSION: {BYTES_METRIC} grew "
               f"{result['gate_bytes']['delta_pct']:+.3f}% (> "
@@ -321,12 +373,24 @@ def cmd_diff(args):
               "that margin is the difference between fitting and an "
               "OOM at scale-up. Check donation/rematerialization or "
               "re-baseline deliberately.", file=sys.stderr)
-    if gate_failed or mem_failed:
+    if shed_failed:
+        g = result["gate_shed_rate"]
+        print(f"SHED-RATE REGRESSION: {SHED_RATE_METRIC} grew "
+              f"{g['old_shed_rate']:.6g} -> {g['new_shed_rate']:.6g} "
+              f"(> {args.tolerance}% tolerance) — the fleet now "
+              "rejects a larger fraction of admitted requests than the "
+              "baseline: capacity shrank, replicas are sicker, or the "
+              "router stopped re-dispatching. Each shed is a client "
+              "retry or a dropped answer. Fix the fleet or re-baseline "
+              "deliberately.", file=sys.stderr)
+    if gate_failed or mem_failed or shed_failed:
         return 2
     if args.gate_bytes:
         print("bytes gate OK", file=sys.stderr)
     if args.gate_peak_mem:
         print("peak-mem gate OK", file=sys.stderr)
+    if args.gate_shed_rate:
+        print("shed-rate gate OK", file=sys.stderr)
     return 0
 
 
@@ -373,8 +437,11 @@ def fleet_summary(base, straggler_factor=1.5):
     this shape)."""
     ranks = []
     pooled = []
+    fleet_events = []
     for r, path in _rank_dirs(base):
         events, torn = _read_events(path)
+        fleet_events.extend(e for e in events
+                            if str(e.get("kind", "")).startswith("fleet_"))
         walls = sorted(float(e["wall_s"]) for e in events
                        if e.get("kind") == "train_step"
                        and e.get("wall_s") is not None)
@@ -420,7 +487,43 @@ def fleet_summary(base, straggler_factor=1.5):
             "p99_wall_s": round(_pct(pooled, 99), 6),
             "median_rank_p50_s": round(median, 6),
         }
+    if fleet_events:
+        out["serving"] = _serving_fleet_summary(fleet_events)
     return out
+
+
+def _serving_fleet_summary(events):
+    """Aggregate the FleetRouter's ``fleet_*`` event stream (round 17)
+    into per-replica routing counts plus per-request timelines: every
+    hop of a request across replicas, keyed by the trace id the router
+    propagated — the whole-fleet request view the per-replica latency
+    histograms cannot give."""
+    counts = {}
+    by_replica = {}
+    requests = {}
+    for e in sorted(events, key=lambda e: e.get("ts", 0)):
+        kind = e["kind"]
+        counts[kind] = counts.get(kind, 0) + 1
+        replica = e.get("replica") or e.get("from_replica")
+        if kind == "fleet_route" and replica:
+            by_replica[replica] = by_replica.get(replica, 0) + 1
+        tid = e.get("trace_id")
+        if tid:
+            hop = {"event": kind, "ts": e.get("ts")}
+            if replica:
+                hop["replica"] = replica
+            requests.setdefault(tid, []).append(hop)
+    routes = counts.get("fleet_route", 0)
+    sheds = counts.get("fleet_shed", 0)
+    return {
+        "events": counts,
+        "routes_by_replica": dict(sorted(by_replica.items())),
+        "shed_rate": round(sheds / max(1, routes + sheds), 6),
+        "redispatched_requests": sum(
+            1 for hops in requests.values()
+            if any(h["event"] == "fleet_redispatch" for h in hops)),
+        "requests": requests,
+    }
 
 
 def cmd_fleet(args):
@@ -444,6 +547,25 @@ def cmd_fleet(args):
     if out["stragglers"]:
         print(f"stragglers (>= x{out['straggler_factor']} median rank "
               f"p50): {out['stragglers']}", file=sys.stderr)
+    sv = out.get("serving")
+    if sv:
+        ev = sv["events"]
+        print(f"serving fleet: {ev.get('fleet_route', 0)} route(s), "
+              f"{ev.get('fleet_redispatch', 0)} redispatch(es), "
+              f"{ev.get('fleet_shed', 0)} shed(s), "
+              f"{ev.get('fleet_drain', 0)} drain(s), "
+              f"{ev.get('fleet_replace', 0)} replace(s); shed rate "
+              f"{sv['shed_rate']}")
+        for replica, n in sv["routes_by_replica"].items():
+            print(f"  {replica}: {n} request(s)")
+        for tid, hops in sv["requests"].items():
+            if len(hops) < 2:     # timelines: the multi-hop requests
+                continue
+            path = " -> ".join(
+                f"{h['event'].replace('fleet_', '')}"
+                + (f"@{h['replica']}" if h.get("replica") else "")
+                for h in hops)
+            print(f"  request {tid}: {path}")
     return 0
 
 
@@ -575,6 +697,10 @@ def main(argv=None):
     p.add_argument("--gate-peak-mem", action="store_true",
                    help="exit 2 when mem::process_peak_bytes grew "
                         "beyond --tolerance")
+    p.add_argument("--gate-shed-rate", action="store_true",
+                   help="exit 2 when the fleet shed rate "
+                        "(fleet::shed_rate / fleet_serving.shed_rate) "
+                        "grew beyond --tolerance")
     p.add_argument("--tolerance", type=float, default=0.0,
                    help="allowed growth in percent (default 0: "
                         "strictly no regression)")
