@@ -179,15 +179,19 @@ def _canon(obj):
 
 def program_key(kind, name, *, symbol=None, symbol_sha=None,
                 input_sigs=(), optimizer=None, mesh=None, fusion=None,
-                passes=None, extra=None):
+                passes=None, partition=None, extra=None):
     """Build the canonical :class:`ProgramKey` for one entry point.
 
     ``input_sigs`` is any structural signature of the runtime inputs
     (shapes/dtypes); ``fusion`` the resolved fusion-flag material;
     ``passes`` the rewrite-pipeline fingerprint (per-pass flag/status/
     site count from symbol/passes/manager.py — cached executables must
-    never mix pass regimes); ``extra`` entry-point-specific trace
-    inputs (guard flag, compute dtype, metric slot signatures, compiler
+    never mix pass regimes); ``partition`` the parameter-partition-rule
+    fingerprint (parallel/partition.py ``rules_fingerprint`` — two
+    processes resolving different layouts trace different programs;
+    None when the feature is off keeps keys byte-identical with
+    pre-partition builds); ``extra`` entry-point-specific trace inputs
+    (guard flag, compute dtype, metric slot signatures, compiler
     options...). Either ``symbol`` or a precomputed ``symbol_sha``
     identifies the graph.
     """
@@ -208,6 +212,8 @@ def program_key(kind, name, *, symbol=None, symbol_sha=None,
         "backend": _backend_identity(),
         "extra": _canon(extra or {}),
     }
+    if partition is not None:
+        materials["partition"] = _canon(partition)
     blob = json.dumps(materials, sort_keys=True).encode("utf-8")
     digest = hashlib.sha256(blob).hexdigest()
     return ProgramKey(kind, name, digest, materials)

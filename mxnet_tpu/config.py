@@ -298,6 +298,20 @@ register("MXTPU_COMPILE_JAX_CACHE", True, bool,
          "Also point JAX's own persistent compilation cache at "
          "CACHE_DIR/xla (a second, backend-level layer on TPU/GPU; "
          "the .mxprog entries remain the primary AOT layer)")
+register("MXTPU_PARTITION_RULES", "", str,
+         "Regex -> PartitionSpec parameter layout rules for mesh binds "
+         "(parallel/partition.py): ';'-separated 'regex=spec' clauses, "
+         "spec a ','-list of mesh axis names with None/* placeholders "
+         "or the word 'replicated'. First re.search match wins. The "
+         "resolved rules are compile-key material. Empty = every "
+         "parameter replicated (pure data parallelism)")
+register("MXTPU_ZERO", "auto", str,
+         "ZeRO-1 sharded weight update on mesh binds (module/fused.py, "
+         "arXiv:2004.13336): each data-parallel replica owns 1/N of "
+         "the optimizer state and updates only its shard; fresh params "
+         "all-gather. Bit-identical to the replicated update. "
+         "auto/1 = on when the optimizer is an elementwise key-free "
+         "rule and the data axis has >1 device; 0 = replicated update")
 
 
 def _autostart_profiler():

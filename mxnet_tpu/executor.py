@@ -288,9 +288,10 @@ class Executor:
         # functions are built from a rewritten graph; self._symbol stays
         # the source of truth for names, serialization and the Monitor's
         # tapped eager pass. Bound array shapes decide applicability
-        # bail-outs here. Mesh binds no longer skip silently: the
-        # manager runs mesh-safe passes and counts the rest into
-        # passes::skipped with reason "mesh_bind".
+        # bail-outs here. Mesh binds run the full mesh-safe pipeline
+        # (round 18: the fused kernels shard_map under mesh_scope and
+        # the gate measures per-device bytes); an unsafe pass counts
+        # into passes::skipped with reason "mesh_bind:<pass>".
         sym = self._symbol
         infer_only = all(r == "null" for r in self.grad_req.values())
         from .symbol import passes as _passes
@@ -305,7 +306,8 @@ class Executor:
         fused_sym, self._pass_report = _passes.apply_pipeline(
             self._symbol, shapes,
             tag="executor_infer" if infer_only else "executor",
-            mode="infer" if infer_only else "train", mesh=self._mesh)
+            mode="infer" if infer_only else "train", mesh=self._mesh,
+            batch_names=self._batch_args or None)
         self._fusion_report = _passes.legacy_fusion_entry(
             self._pass_report)
         if fused_sym is not None:
@@ -403,6 +405,14 @@ class Executor:
         spec = P("data") if name in self._batch_args else P()
         return jax.device_put(val, NamedSharding(self._mesh, spec))
 
+    def _trace_scope(self):
+        """Mesh scope for jit entry points: the fused Pallas ops wrap
+        themselves in shard_map when TRACED under an active mesh scope
+        (ops/pallas_fused.py, round 18), and jit traces lazily at first
+        call — so every call site enters the scope (no-op off-mesh)."""
+        from .ops.pallas_fused import mesh_scope
+        return mesh_scope(self._mesh)
+
     # -- execution ------------------------------------------------------------
     def forward(self, is_train=False, **kwargs):
         """(reference: executor.py:113)"""
@@ -445,9 +455,10 @@ class Executor:
             for name, o in internals.items():
                 self._monitor_callback(name, _wrap(o))
         else:
-            outs, aux_updates = self._fwd_jit(arg_vals, aux_vals,
-                                              _random.next_key(),
-                                              bool(is_train))
+            with self._trace_scope():
+                outs, aux_updates = self._fwd_jit(arg_vals, aux_vals,
+                                                  _random.next_key(),
+                                                  bool(is_train))
         self.outputs = [_wrap(o) for o in outs]
         self._apply_aux_updates(aux_updates)
         if monitor_now and not self._monitor_all:
@@ -480,8 +491,9 @@ class Executor:
             head_grads = tuple(
                 g._data if isinstance(g, NDArray) else jnp.asarray(g)
                 for g in out_grads)
-        grads, (outs, aux_updates) = self._fwd_loss_grad(
-            arg_vals, aux_vals, head_grads, _random.next_key())
+        with self._trace_scope():
+            grads, (outs, aux_updates) = self._fwd_loss_grad(
+                arg_vals, aux_vals, head_grads, _random.next_key())
         self.outputs = [_wrap(o) for o in outs]
         self._apply_aux_updates(aux_updates)
         for name, g in zip(self._run_arg_names, grads):

@@ -50,7 +50,8 @@ class FusedSymbolStep:
 
     def __init__(self, symbol, data_names, label_names, param_names,
                  aux_names, trainable, optimizer, mesh=None,
-                 data_axis="data", compute_dtype=None):
+                 data_axis="data", compute_dtype=None,
+                 partition_rules=None):
         self.symbol = symbol
         self.arg_names = symbol.list_arguments()
         self.aux_names = list(aux_names)
@@ -62,6 +63,20 @@ class FusedSymbolStep:
         self.trainable = dict(trainable)  # param name -> bool
         self.mesh = mesh
         self.data_axis = data_axis
+        # regex -> PartitionSpec parameter layout rules (parallel/
+        # partition.py): explicit arg wins, else MXTPU_PARTITION_RULES;
+        # only consulted on mesh binds
+        if partition_rules is None and mesh is not None:
+            from ..parallel import partition as _partition
+            partition_rules = _partition.env_rules()
+        self.partition_rules = partition_rules or []
+        # ZeRO-1 sharded update (arXiv:2004.13336): decided at start()
+        self._zero = False
+        self._zero_ndev = 1
+        self._param_specs = None        # per-big-param PartitionSpec
+        self._opt_state_specs = None    # per-big-param per-leaf spec
+        self._flat_state_specs = None   # per-flat-leaf spec
+        self._flat_total = 0            # _small_total padded to ndev
         # bf16 compute with fp32 master params/aux — the fused analog of
         # the optimizer's multi_precision path (reference: optimizer.py
         # create_state_multi_precision :247)
@@ -171,6 +186,13 @@ class FusedSymbolStep:
             self._small_off[n] = (off, sz, tuple(arg_dict[n]._data.shape))
             off += sz
         self._small_total = off
+        # ZeRO-1: the packed buffer pads to a multiple of the replica
+        # count so every device owns an equal contiguous optimizer-state
+        # shard. Padding is inert under every elementwise rule: p=0,
+        # g=0 (no loss term reaches it), lr_mult=1, wd=0 keep the pad
+        # exactly zero forever
+        self._flat_total = off + ((-off) % self._zero_ndev
+                                  if self._zero_ndev > 1 else 0)
         self._aux_off = {}
         off = 0
         for n in self._aux_small_names:
@@ -180,9 +202,10 @@ class FusedSymbolStep:
             off += sz
         self._aux_total = off
         # per-element lr/wd multiplier vectors for the packed update
+        # (sized to the PADDED total: pad lr_mult=1 / wd=0)
         if self._small_total:
-            lrm = np.ones(self._small_total, np.float32)
-            wdv = np.zeros(self._small_total, np.float32)
+            lrm = np.ones(self._flat_total, np.float32)
+            wdv = np.zeros(self._flat_total, np.float32)
             pidx = {n: i for i, n in enumerate(self.param_names)}
             for n, (o, sz, _) in self._small_off.items():
                 lrm[o:o + sz] = self._lr_mults[pidx[n]]
@@ -204,7 +227,9 @@ class FusedSymbolStep:
                   for d in (arg_dict, aux_dict) for n in d}
         fused_sym, self.pass_report = _passes.apply_pipeline(
             self.symbol, shapes, tag="fused_step", mode="train",
-            mesh=self.mesh, compute_dtype=self.compute_dtype)
+            mesh=self.mesh, compute_dtype=self.compute_dtype,
+            batch_names=set(self.data_names) | set(self.label_names),
+            data_axis=self.data_axis)
         self.fusion_report = _passes.legacy_fusion_entry(
             self.pass_report)
         self._passes_material = _passes.pipeline_key_material(
@@ -246,26 +271,117 @@ class FusedSymbolStep:
             v = jnp.array(v, copy=True)
             return jax.device_put(v, rep) if rep is not None else v
 
+        # ZeRO-1 sharded update (MXTPU_ZERO, arXiv:2004.13336): each
+        # replica owns 1/N of the optimizer state and updates only its
+        # shard; GSPMD all-gathers the fresh params. Needs an
+        # elementwise, key-free rule (a norm-based rule like LARS reads
+        # the whole tensor) and >1 device on the data axis.
+        from .. import config as _config
+        ndev = int(self.mesh.shape.get(self.data_axis, 0)) \
+            if self.mesh is not None else 0
+        eligible = (ndev > 1
+                    and getattr(self._fopt, "elementwise", False)
+                    and not self._fopt.needs_key)
+        zmode = str(_config.get("MXTPU_ZERO", "auto")).strip().lower()
+        if zmode in ("0", "false", "off", "no"):
+            self._zero = False
+        else:
+            self._zero = eligible
+            if zmode in ("1", "true", "on", "yes") and not eligible \
+                    and ndev > 1:
+                import logging
+                logging.getLogger("mxnet_tpu.module").warning(
+                    "MXTPU_ZERO=1 but optimizer '%s' is not an "
+                    "elementwise key-free rule; running the replicated "
+                    "update", type(self.optimizer).__name__)
+        self._zero_ndev = ndev if self._zero else 1
         self._partition(arg_dict, aux_dict)
-        self._pvals = tuple(_prep(arg_dict[n]._data)
-                            for n in self._big_names)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # regex partition rules decide each big param's layout (TP);
+        # unruled params replicate. Rule-sharded params are excluded
+        # from ZeRO (their optimizer state already follows the param's
+        # partitioning below).
+        rules = self.partition_rules if self.mesh is not None else []
+        sparse_names = {s.weight_name for s in self._sparse_sites}
+        self._param_specs = []
+        for n in self._big_names:
+            spec = P()
+            if rules:
+                from ..parallel import partition as _part
+                v = arg_dict[n]._data
+                spec = _part.spec_for(rules, n, ndim=v.ndim)
+                _part.validate_specs(self.mesh, {n: spec},
+                                     {n: tuple(v.shape)})
+            self._param_specs.append(spec)
+        # per-big-param ZeRO eligibility: trainable, dense-grad (sparse
+        # tables take the lazy row update), replicated layout, and dim0
+        # divisible by the replica count
+        self._zero_big = []
+        for n, spec in zip(self._big_names, self._param_specs):
+            v = arg_dict[n]._data
+            self._zero_big.append(bool(
+                self._zero and self.trainable.get(n, True)
+                and n not in sparse_names and tuple(spec) == ()
+                and v.ndim >= 1 and v.shape[0] % ndev == 0
+                and v.shape[0] >= ndev))
+
+        def _put(v, spec):
+            if self.mesh is None:
+                return v
+            return jax.device_put(v, NamedSharding(self.mesh, spec))
+
+        self._pvals = tuple(
+            _put(jnp.array(arg_dict[n]._data, copy=True), spec)
+            for n, spec in zip(self._big_names, self._param_specs))
         self._aux_vals = tuple(_prep(aux_dict[n]._data)
                                for n in self._aux_big_names)
-        self._opt_state = tuple(
-            tuple(jax.device_put(x, rep) if rep is not None else x
-                  for x in self._fopt.init(v))
-            if self.trainable.get(n, True) else ()
-            for n, v in zip(self._big_names, self._pvals))
+
+        def _leaf_spec(leaf, pshape, pspec, zero):
+            shp = tuple(getattr(leaf, "shape", ()))
+            if shp != tuple(pshape) or not shp:
+                return P()      # scalar schedule leaves replicate
+            if zero:
+                return P(self.data_axis)   # ZeRO shard over dim0
+            return pspec        # TP state follows the param layout
+
+        opt_state, opt_specs = [], []
+        for n, v, pspec, zb in zip(self._big_names, self._pvals,
+                                   self._param_specs, self._zero_big):
+            if not self.trainable.get(n, True):
+                opt_state.append(())
+                opt_specs.append(())
+                continue
+            leaves = self._fopt.init(v)
+            specs = tuple(_leaf_spec(x, v.shape, pspec, zb)
+                          for x in leaves)
+            opt_state.append(tuple(_put(x, s)
+                                   for x, s in zip(leaves, specs)))
+            opt_specs.append(specs)
+        self._opt_state = tuple(opt_state)
+        self._opt_state_specs = tuple(opt_specs)
         self._flat_p = _prep(self._pack_params(arg_dict)) \
             if self._small_total else None
         self._flat_aux = _prep(self._pack_aux(aux_dict)) \
             if self._aux_total else None
         if self._small_total:
+            leaves = self._fopt.init(self._flat_p)
+            self._flat_state_specs = tuple(
+                _leaf_spec(x, (self._flat_total,), P(), self._zero)
+                for x in leaves)
             self._flat_state = tuple(
-                jax.device_put(x, rep) if rep is not None else x
-                for x in self._fopt.init(self._flat_p))
+                _put(x, s) for x, s
+                in zip(leaves, self._flat_state_specs))
         else:
             self._flat_state = ()
+            self._flat_state_specs = ()
+        if self.mesh is not None:
+            from ..telemetry import registry as _treg2
+            om = self.optimizer_memory()
+            _treg2.gauge("mem::optimizer::logical_bytes").set(
+                om["logical_bytes"])
+            _treg2.gauge("mem::optimizer::per_device_bytes").set(
+                om["per_device_bytes"])
         t0 = jnp.zeros((), jnp.uint32)
         self._t_dev = jax.device_put(t0, rep) if rep is not None else t0
         f0 = jnp.zeros((2,), jnp.int32)
@@ -278,6 +394,9 @@ class FusedSymbolStep:
     def _pack_params(self, arg_dict):
         vals = [np.asarray(arg_dict[n]._data).ravel()
                 for n in self._small_names]
+        pad = self._flat_total - self._small_total
+        if pad:
+            vals.append(np.zeros(pad, np.float32))
         return jnp.asarray(np.concatenate(vals).astype(np.float32))
 
     def _pack_aux(self, aux_dict):
@@ -326,6 +445,58 @@ class FusedSymbolStep:
         metric_rules = self._metric_rules or []
         out_names = self.symbol.list_outputs()
         guard = self.guard_enabled
+
+        # ZeRO-1 (arXiv:2004.13336): each replica updates a contiguous
+        # 1/N shard of the eligible params with its LOCAL optimizer-
+        # state shard; the param out_sharding (replicated) makes GSPMD
+        # all-gather the fresh values — reduce-scatter(g) + local
+        # update + all-gather(p), bit-identical to the replicated
+        # update because every rule involved is elementwise (an
+        # elementwise update of a slice IS the slice of the elementwise
+        # update).
+        mesh = self.mesh
+        axis = self.data_axis
+        ndev = self._zero_ndev
+        zero_big = list(self._zero_big or ())
+        zero_big += [False] * (len(self._big_names) - len(zero_big))
+        zero_flat = self._zero and has_flat
+        opt_specs = self._opt_state_specs or ()
+        flat_specs = self._flat_state_specs or ()
+
+        if zero_flat or any(zero_big):
+            from jax.sharding import PartitionSpec as _P
+            from ..ops.pallas_fused import _shard_map
+
+            def _zero_update(p, g, s, s_specs, lr, t, lrm, wd):
+                """One sharded optimizer step. ``lrm``/``wd`` are the
+                per-element vectors of the packed buffer or plain
+                python multipliers of a big param — both concrete, so
+                closing over them is safe (lr/t are TRACERS and must
+                ride in as shard_map arguments)."""
+                rows = p.shape[0] // ndev
+                vec = hasattr(lrm, "ndim")
+
+                def body(p, g, lr, t, *sl):
+                    i0 = jax.lax.axis_index(axis) * rows
+                    pl = jax.lax.dynamic_slice_in_dim(p, i0, rows, 0)
+                    gl = jax.lax.dynamic_slice_in_dim(g, i0, rows, 0)
+                    if vec:
+                        lr_l = lr * jax.lax.dynamic_slice_in_dim(
+                            lrm, i0, rows, 0)
+                        wd_l = jax.lax.dynamic_slice_in_dim(
+                            wd, i0, rows, 0)
+                    else:
+                        lr_l, wd_l = lr * lrm, wd
+                    np_, ns_ = fopt.update(pl, gl, tuple(sl), lr_l,
+                                           t + 1, wd_l)
+                    return (np_,) + tuple(ns_)
+
+                res = _shard_map(
+                    body, mesh=mesh,
+                    in_specs=(_P(), _P(), _P(), _P()) + tuple(s_specs),
+                    out_specs=(_P(axis),) + tuple(s_specs),
+                    check_rep=False)(p, g, lr, t, *s)
+                return res[0], tuple(res[1:])
 
         # base_key is a runtime ARGUMENT, not a closure constant: baked
         # into the executable it would make every process's programs
@@ -423,6 +594,10 @@ class FusedSymbolStep:
                             np_, ns_ = fopt.row_update(
                                 p, g.ids, g.rows, s, lr * lr_mults[i],
                                 t + 1, wd_eff[i])
+                        elif zero_big[i]:
+                            np_, ns_ = _zero_update(
+                                p, g, s, opt_specs[i], lr, t,
+                                lr_mults[i], wd_eff[i])
                         else:
                             pkey = jax.random.fold_in(
                                 jax.random.fold_in(key, 0x6F707469), i) \
@@ -436,8 +611,14 @@ class FusedSymbolStep:
                         new_p.append(p)
                         new_s.append(s)
                 if has_flat:
-                    nf, nfs = fopt.update(flat_p, grad_flat, flat_state,
-                                          lr * flat_lrm, t + 1, flat_wd)
+                    if zero_flat:
+                        nf, nfs = _zero_update(
+                            flat_p, grad_flat, flat_state, flat_specs,
+                            lr, t, flat_lrm, flat_wd)
+                    else:
+                        nf, nfs = fopt.update(
+                            flat_p, grad_flat, flat_state,
+                            lr * flat_lrm, t + 1, flat_wd)
                     new_flat, new_flat_s = nf.astype(jnp.float32), nfs
                 else:
                     new_flat, new_flat_s = flat_p, flat_state
@@ -528,10 +709,20 @@ class FusedSymbolStep:
             shard_inputs = set(self.data_names) | set(self.label_names)
             feed_sh = tuple(batched if n in shard_inputs else rep
                             for n in self.input_names)
-            prep = tuple(rep for _ in self._big_names)
-            srep = tuple(tuple(rep for _ in st) for st in self._opt_state)
+            # params follow their partition rule (replicated without
+            # one); optimizer state follows the specs recorded at
+            # start() — ZeRO shards P(data) over dim0, scalar schedule
+            # leaves replicate. in == out keeps donation zero-copy.
+            prep = tuple(NamedSharding(self.mesh, s)
+                         for s in (self._param_specs
+                                   or [P()] * len(self._big_names)))
+            srep = tuple(
+                tuple(NamedSharding(self.mesh, s) for s in specs)
+                for specs in (self._opt_state_specs
+                              or [()] * len(self._opt_state)))
             frep = rep if self._flat_p is not None else None
-            fsrep = tuple(rep for _ in self._flat_state)
+            fsrep = tuple(NamedSharding(self.mesh, s)
+                          for s in (self._flat_state_specs or ()))
             farep = rep if self._flat_aux is not None else None
             arep = tuple(rep for _ in self._aux_big_names)
             mrep = tuple(rep for _ in (self._metric_state or ()))
@@ -730,10 +921,15 @@ class FusedSymbolStep:
                                  bytes_accessed=cost.get("bytes accessed"))
                     self._noted_cost = (weakref.ref(tl), sig)
         with tl.phase("device_step") if tl else _tlmod.null_phase():
-            (self._pvals, self._opt_state, self._flat_p,
-             self._flat_state, self._aux_vals, self._flat_aux,
-             self._metric_state, self._fault_state, outs,
-             self._t_dev) = prog(*args)
+            # mesh scope so a plain-jit fallback tracing HERE still
+            # shard_maps the fused kernels (no-op when already compiled
+            # or off-mesh)
+            from ..ops.pallas_fused import mesh_scope
+            with mesh_scope(self.mesh, self.data_axis):
+                (self._pvals, self._opt_state, self._flat_p,
+                 self._flat_state, self._aux_vals, self._flat_aux,
+                 self._metric_state, self._fault_state, outs,
+                 self._t_dev) = prog(*args)
         self.num_update += 1
         with tl.phase("metric_ft_sync") if tl else _tlmod.null_phase():
             self._check_abort()
@@ -765,12 +961,18 @@ class FusedSymbolStep:
             # gradients (and their vocab/dim) changes the traced
             # program — a dense-vs-sparse flip must never cache-hit
             "sparse": [s.describe() for s in self._sparse_sites],
+            # sharded-update regime: a ZeRO step and a replicated step
+            # are different programs over identical shapes
+            "zero": int(self._zero_ndev) if self._zero else 0,
         }
+        from ..parallel import partition as _part
         return compile_mod.program_key(
             "fused_step", f"fused_step:{self.symbol.name}",
             symbol_sha=self._symbol_sha, input_sigs=sig,
             optimizer=self.optimizer, mesh=self.mesh, fusion=fusion,
-            passes=self._passes_material, extra=extra)
+            passes=self._passes_material,
+            partition=_part.rules_fingerprint(self.partition_rules),
+            extra=extra)
 
     def _acquire_program(self, sig, args):
         """Route one compile through the registry: AOT-load from the
@@ -780,10 +982,17 @@ class FusedSymbolStep:
         the AOT machinery itself degrades to the plain jit — slower,
         never wrong."""
         from .. import compile as compile_mod
+        from ..ops.pallas_fused import mesh_scope
+
+        def _lower():
+            # the fused Pallas ops read the ambient mesh scope at trace
+            # time to wrap themselves in shard_map (round 18)
+            with mesh_scope(self.mesh, self.data_axis):
+                return self._step_jit.lower(*args)
+
         try:
             key = self._program_key(sig)
-            exe, source = compile_mod.load_or_compile(
-                key, lambda: self._step_jit.lower(*args))
+            exe, source = compile_mod.load_or_compile(key, _lower)
             compile_mod.note_entry_point(key.name, key, sig)
         except Exception as e:  # AOT path unavailable: degrade loudly
             import logging
@@ -872,9 +1081,11 @@ class FusedSymbolStep:
         feed_vals = tuple(feed[n] for n in self.input_names)
         if self._lr_cache is None:
             self._lr_cache = (0.0, jnp.asarray(0.0, jnp.float32))
-        return self._step_jit.lower(*self._state_args(), feed_vals,
-                                    self._t_dev, self._lr_cache[1],
-                                    self._base_key)
+        from ..ops.pallas_fused import mesh_scope
+        with mesh_scope(self.mesh, self.data_axis):
+            return self._step_jit.lower(*self._state_args(), feed_vals,
+                                        self._t_dev, self._lr_cache[1],
+                                        self._base_key)
 
     def _feed_sig(self, feed):
         return tuple((tuple(feed[n].shape), str(feed[n].dtype))
@@ -915,6 +1126,33 @@ class FusedSymbolStep:
         paying a second lower+compile."""
         return self._program_exes.get(self._feed_sig(feed))
 
+    def optimizer_memory(self):
+        """Optimizer-state footprint: ``logical_bytes`` (the state's
+        global size) vs ``per_device_bytes`` (what ONE device actually
+        holds — 1/N of every ZeRO-sharded leaf plus full copies of
+        replicated ones). The ~1/N ratio is THE memory win of the
+        sharded update (arXiv:2004.13336); memory_report()'s
+        ``mem::optimizer::*`` gauges carry these numbers."""
+        leaves = [x for st in (self._opt_state or ()) for x in st]
+        leaves += [x for x in (self._flat_state or ())]
+        logical = sum(int(x.size) * x.dtype.itemsize for x in leaves)
+        out = {"logical_bytes": logical, "zero": bool(self._zero),
+               "ndev": int(self._zero_ndev)}
+        if self.mesh is None:
+            out["per_device_bytes"] = logical
+            return out
+        dev0 = self.mesh.devices.flat[0]
+        per_dev = 0
+        for x in leaves:
+            shards = getattr(x, "addressable_shards", None)
+            if not shards:
+                per_dev += int(x.size) * x.dtype.itemsize
+                continue
+            per_dev += sum(int(sh.data.size) * x.dtype.itemsize
+                           for sh in shards if sh.device == dev0)
+        out["per_device_bytes"] = per_dev
+        return out
+
     def load_params(self, arg_dict, aux_dict):
         """Refresh parameter/aux buffers from executor arrays (set_params
         mid-run); optimizer state is kept, matching the eager Updater."""
@@ -924,8 +1162,17 @@ class FusedSymbolStep:
             v = jnp.array(v, copy=True)
             return jax.device_put(v, rep) if rep is not None else v
 
-        self._pvals = tuple(_prep(arg_dict[n]._data)
-                            for n in self._big_names)
+        def _put(v, spec):
+            v = jnp.array(v, copy=True)
+            if self.mesh is None:
+                return v
+            from jax.sharding import NamedSharding
+            return jax.device_put(v, NamedSharding(self.mesh, spec))
+
+        from jax.sharding import PartitionSpec as P
+        specs = self._param_specs or [P()] * len(self._big_names)
+        self._pvals = tuple(_put(arg_dict[n]._data, s)
+                            for n, s in zip(self._big_names, specs))
         self._aux_vals = tuple(_prep(aux_dict[n]._data)
                                for n in self._aux_big_names)
         if self._small_total:
@@ -997,9 +1244,27 @@ class FusedSymbolStep:
                 f"optimizer states were saved for '{saved_opt}' but the "
                 f"module now runs '{cur_opt}'")
         self.num_update = obj["num_update"]
-        self._t_dev = jnp.asarray(self.num_update, jnp.uint32)
+        rep = self._rep_sharding()
+        t_dev = jnp.asarray(self.num_update, jnp.uint32)
+        self._t_dev = jax.device_put(t_dev, rep) if rep is not None \
+            else t_dev
+
+        def _put(v, spec):
+            # restore THIS world's recorded sharding: states in a
+            # checkpoint are logical (gathered) arrays, and the mesh —
+            # or its size — may have changed since they were saved
+            # (elastic re-form resume, parallel/elastic.py)
+            if self.mesh is None:
+                return v
+            from jax.sharding import NamedSharding
+            return jax.device_put(v, NamedSharding(self.mesh, spec))
+
+        from jax.sharding import PartitionSpec as P
+        specs_by_big = self._opt_state_specs or \
+            tuple(tuple(P() for _ in cur) for cur in self._opt_state)
         new_state = []
-        for n, cur in zip(self._big_names, self._opt_state):
+        for n, cur, specs in zip(self._big_names, self._opt_state,
+                                 specs_by_big):
             saved = obj["state"].get(n)
             if saved is None:
                 new_state.append(cur)
@@ -1009,8 +1274,10 @@ class FusedSymbolStep:
                     f"saved optimizer state for '{n}' has {len(saved)} "
                     f"leaves, expected {len(cur)} — optimizer mismatch?")
             new_state.append(tuple(
-                jnp.asarray(s, dtype=getattr(c, "dtype", jnp.float32))
-                for s, c in zip(saved, cur)))
+                _put(jnp.asarray(s,
+                                 dtype=getattr(c, "dtype", jnp.float32)),
+                     sp)
+                for s, c, sp in zip(saved, cur, specs)))
         self._opt_state = tuple(new_state)
         if self._small_total and self._flat_state:
             leaves = [np.asarray(leaf).copy()
@@ -1033,4 +1300,8 @@ class FusedSymbolStep:
                         # for every name, last write wins
                         leaves[j] = np.asarray(sv).reshape(
                             leaves[j].shape)
-            self._flat_state = tuple(jnp.asarray(x) for x in leaves)
+            fspecs = self._flat_state_specs or \
+                tuple(P() for _ in leaves)
+            self._flat_state = tuple(
+                _put(jnp.asarray(x), sp)
+                for x, sp in zip(leaves, fspecs))

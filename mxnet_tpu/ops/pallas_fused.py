@@ -37,17 +37,77 @@ ops, so tier-1 CPU tests exercise the same op, rewrite, and VJP.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
+import threading
 
 import jax
 import jax.numpy as jnp
 
 from .registry import register_op
 
+try:  # jax>=0.4.35 moved shard_map out of experimental
+    from jax import shard_map as _shard_map  # type: ignore
+except ImportError:  # pragma: no cover - version shim
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 __all__ = ["bn_relu_matmul", "bn_relu_conv_nchw", "select_tiles",
            "select_conv_tiles", "conv_tile_failure",
-           "fused_bn_relu_conv"]
+           "fused_bn_relu_conv", "mesh_scope", "active_mesh"]
+
+# ---------------------------------------------------------------------------
+# trace-time mesh scope (ROADMAP item 1: shard_map-compatible kernels)
+# ---------------------------------------------------------------------------
+# GSPMD cannot partition an opaque Pallas custom call, so under a mesh
+# bind the kernel invocations below wrap themselves in shard_map over
+# the batch axis — each device runs the kernel on its batch shard, and
+# the surrounding statistics/folding/backward stay plain jnp for GSPMD
+# to partition (global BN batch stats, psum'd parameter gradients).
+# The mesh reaches the op at TRACE time through this scope: the fused
+# step / pass-manager measurement enters mesh_scope(mesh, axis) around
+# lowering, and the op reads it when the pallas_call is built. AD never
+# differentiates through the shard_map (it sits inside the ops' custom
+# VJPs, whose backward is plain jnp): jax cannot transpose a
+# check_rep=False shard_map, and check_rep=False is mandatory because
+# pallas_call has no replication rule.
+_MESH_SCOPE = threading.local()
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh, axis="data"):
+    """Declare the mesh/batch-axis for fused kernels traced inside the
+    scope (thread-local; trace-time only — the compiled program carries
+    the shard_map, not the scope)."""
+    prev = getattr(_MESH_SCOPE, "value", None)
+    _MESH_SCOPE.value = None if mesh is None else (mesh, axis)
+    try:
+        yield
+    finally:
+        _MESH_SCOPE.value = prev
+
+
+def active_mesh():
+    """The (mesh, batch_axis) declared by the innermost
+    :func:`mesh_scope`, or None (single-device trace)."""
+    return getattr(_MESH_SCOPE, "value", None)
+
+
+def _batch_shards(batch):
+    """(mesh, axis, per-device batch) when a mesh scope is active and
+    the batch divides its axis; else None (the kernel stays unwrapped —
+    off-mesh traces, and mesh traces whose batch cannot split, which
+    the rewrite passes' bytes gate then judges as-is)."""
+    scope = active_mesh()
+    if scope is None:
+        return None
+    mesh, axis = scope
+    if axis not in getattr(mesh, "shape", {}):
+        return None
+    ndev = int(mesh.shape[axis])
+    if ndev <= 1 or batch % ndev:
+        return None
+    return mesh, axis, batch // ndev
 
 # output-tile candidates, largest first; TPU-friendly multiples of 8.
 # small trailing candidates keep interpret-mode (CPU test) shapes fusable.
@@ -309,7 +369,14 @@ def bn_relu_conv_nchw(x, w, scale, shift, relu=True, interpret=None):
     ``interpret=True`` in its pallas_call — i.e. don't).
 
     Forward only; the graph op's custom VJP (analytic fused BN backward)
-    lives in ``_fused_bn_conv_vjp``."""
+    lives in ``_fused_bn_conv_vjp``.
+
+    Under an active :func:`mesh_scope` whose batch axis divides B, the
+    pallas_call wraps itself in ``shard_map(..., check_rep=False)``
+    over the batch dimension — per-device kernel on the batch shard,
+    weights/folded-stats replicated — so the op composes with GSPMD
+    partitioning instead of being an opaque custom call the mesh bind
+    must reject (ROADMAP item 1)."""
     from jax.experimental import pallas as pl
     b, c, h, w_sp = x.shape
     s = h * w_sp
@@ -317,11 +384,27 @@ def bn_relu_conv_nchw(x, w, scale, shift, relu=True, interpret=None):
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if interpret:
-        xhat = pl.pallas_call(
-            _make_prologue_kernel(relu),
-            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
-            interpret=True,
-        )(x, scale.reshape(1, c, 1, 1), shift.reshape(1, c, 1, 1))
+        kern = _make_prologue_kernel(relu)
+
+        def _prologue(xl, sc, sh):
+            return pl.pallas_call(
+                kern,
+                out_shape=jax.ShapeDtypeStruct(xl.shape, xl.dtype),
+                interpret=True,
+            )(xl, sc, sh)
+
+        sc = scale.reshape(1, c, 1, 1)
+        sh = shift.reshape(1, c, 1, 1)
+        ms = _batch_shards(b)
+        if ms is not None:
+            from jax.sharding import PartitionSpec as P
+            mesh, axis, _ = ms
+            xhat = _shard_map(_prologue, mesh=mesh,
+                              in_specs=(P(axis), P(), P()),
+                              out_specs=P(axis),
+                              check_rep=False)(x, sc, sh)
+        else:
+            xhat = _prologue(x, sc, sh)
         return _conv1x1(xhat, w.reshape(o, c, 1, 1)).astype(x.dtype), \
             xhat
     tiles = select_conv_tiles(o, s)
@@ -330,19 +413,38 @@ def bn_relu_conv_nchw(x, w, scale, shift, relu=True, interpret=None):
             f"bn_relu_conv_nchw: {conv_tile_failure(o, s)}; pad the "
             "problem")
     bo, bs = tiles
-    out = pl.pallas_call(
-        _make_nchw_kernel(relu),
-        grid=(b, o // bo, s // bs),
-        in_specs=[
-            pl.BlockSpec((bo, c), lambda g, i, j: (i, 0)),
-            pl.BlockSpec((1, c, bs), lambda g, i, j: (g, 0, j)),
-            pl.BlockSpec((c, 1), lambda g, i, j: (0, 0)),
-            pl.BlockSpec((c, 1), lambda g, i, j: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bo, bs), lambda g, i, j: (g, i, j)),
-        out_shape=jax.ShapeDtypeStruct((b, o, s), x.dtype),
-        interpret=False,
-    )(w, x.reshape(b, c, s), scale.reshape(c, 1), shift.reshape(c, 1))
+    kern = _make_nchw_kernel(relu)
+
+    def _tiled(wl, xl, sc, sh):
+        bl = xl.shape[0]          # per-device batch inside shard_map
+        return pl.pallas_call(
+            kern,
+            grid=(bl, o // bo, s // bs),
+            in_specs=[
+                pl.BlockSpec((bo, c), lambda g, i, j: (i, 0)),
+                pl.BlockSpec((1, c, bs), lambda g, i, j: (g, 0, j)),
+                pl.BlockSpec((c, 1), lambda g, i, j: (0, 0)),
+                pl.BlockSpec((c, 1), lambda g, i, j: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, bo, bs),
+                                   lambda g, i, j: (g, i, j)),
+            out_shape=jax.ShapeDtypeStruct((bl, o, s), xl.dtype),
+            interpret=False,
+        )(wl, xl, sc, sh)
+
+    xr = x.reshape(b, c, s)
+    sc = scale.reshape(c, 1)
+    sh = shift.reshape(c, 1)
+    ms = _batch_shards(b)
+    if ms is not None:
+        from jax.sharding import PartitionSpec as P
+        mesh, axis, _ = ms
+        out = _shard_map(_tiled, mesh=mesh,
+                         in_specs=(P(), P(axis), P(), P()),
+                         out_specs=P(axis),
+                         check_rep=False)(w, xr, sc, sh)
+    else:
+        out = _tiled(w, xr, sc, sh)
     return out.reshape(b, o, h, w_sp), None
 
 

@@ -307,7 +307,36 @@ class ElasticCheckpointManager(CheckpointManager):
                                    data_state=data_state)
 
 
-def prepare_resume(manager, train_data, world=None, rank=None):
+def _check_reshard(module, old_world, world):
+    """Fail-fast half of the changed-world re-shard: checkpointed
+    params/optimizer state are *logical* (gathered) arrays, and the
+    re-formed generation's bind already recorded partition specs for
+    the NEW mesh — ``fit``'s auto-resume loads the checkpoint THROUGH
+    those specs (module/fused.py ``load_params``/``set_states`` re-
+    ``device_put`` every array, and the ZeRO-1 flat pack re-pads to the
+    new replica count). The one thing that can still go wrong is a
+    partition rule that divided at the old world but not the new one —
+    caught here with the parameter's name, instead of as a GSPMD shape
+    complaint deep inside the first post-resume compile."""
+    fused = getattr(module, "_fused", None) or module
+    mesh = getattr(fused, "mesh", None)
+    rules = getattr(fused, "partition_rules", None)
+    from ..telemetry import registry as _treg
+    _treg.counter("elastic::reshard").inc()
+    if mesh is None or not rules:
+        return
+    try:
+        arg_params, _ = module.get_params()
+    except Exception:                          # noqa: BLE001
+        return   # params not initialized yet; bind will validate
+    from . import partition as _partition
+    shapes = {n: tuple(v.shape) for n, v in arg_params.items()}
+    specs = _partition.match_partition_rules(rules, shapes, strict=False)
+    _partition.validate_specs(mesh, specs, shapes)
+
+
+def prepare_resume(manager, train_data, world=None, rank=None,
+                   module=None):
     """Pre-``fit`` resume policy for an elastic generation: load the
     newest checkpoint's elastic stamp and decide what the data iterator
     may restore.
@@ -323,7 +352,14 @@ def prepare_resume(manager, train_data, world=None, rank=None):
     shadowed with ``None`` on the *instance* — ``fit`` checks
     ``callable(...)`` and skips silently) and the epoch re-shards from
     its start under the new world, which is the correct
-    epoch-granularity recovery.
+    epoch-granularity recovery. Mesh-partitioned state re-shards
+    automatically: the checkpoint holds logical (gathered) arrays and
+    the new generation's bind loads them through the partition specs it
+    recorded for its OWN mesh — including the ZeRO-1 optimizer shards,
+    which re-pad and re-split at the new replica count
+    (module/fused.py). Pass ``module`` (bound at the new world) to also
+    validate up front that every partition rule still divides at the
+    re-formed mesh, with the parameter's name in the error.
 
     Returns the :class:`~mxnet_tpu.checkpoint.CheckpointState` (or None
     when there is nothing to resume from)."""
@@ -345,6 +381,8 @@ def prepare_resume(manager, train_data, world=None, rank=None):
             train_data.set_state = None
         except Exception:                      # noqa: BLE001
             pass
+        if module is not None:
+            _check_reshard(module, int(old_world), world)
     return state
 
 

@@ -1,0 +1,167 @@
+"""Regex-rule parameter partitioning (ROADMAP item 1).
+
+``match_partition_rules`` maps an ordered list of ``(regex,
+PartitionSpec)`` rules over a named parameter tree — the established
+idiom for declaring tensor-parallel layouts over large named trees
+(SNIPPETS.md [2]): first ``re.search`` match wins, scalars are always
+replicated, and in strict mode an unmatched parameter is an ERROR, not
+a silent replication — a partitioning that quietly skips a parameter is
+exactly the kind of wrong that only shows up as an OOM three models
+later.
+
+Rules also come from the environment (``MXTPU_PARTITION_RULES``) in a
+flat text form so launch scripts can flip layouts without code:
+
+    MXTPU_PARTITION_RULES="fc.*_weight=model,None;.*=replicated"
+
+Each clause is ``regex=spec`` (``;``-separated); a spec is a
+``,``-separated PartitionSpec — axis names partition the matching
+dimension, ``None`` (or ``*``) replicates it, and the whole-spec words
+``replicated``/``rep`` mean ``P()``. The parsed rules feed
+``FusedSymbolStep`` / ``TrainStep`` parameter layouts and
+``rules_fingerprint`` is compile-key material (compile/key.py): two
+processes resolving different partition regimes trace different
+programs and must never share a cached executable.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["parse_rules", "match_partition_rules", "spec_for",
+           "rules_fingerprint", "env_rules", "shard_params",
+           "validate_specs"]
+
+
+def _parse_spec(text: str):
+    """One spec clause -> PartitionSpec. ``replicated``/``rep``/empty
+    mean P(); otherwise a ``,``-list of axis names with ``None``/``*``
+    as the replicated-dimension placeholder."""
+    from jax.sharding import PartitionSpec as P
+    t = text.strip()
+    if t.lower() in ("", "replicated", "rep", "p()"):
+        return P()
+    parts = []
+    for tok in t.split(","):
+        tok = tok.strip()
+        if tok.lower() in ("none", "*", ""):
+            parts.append(None)
+        else:
+            parts.append(tok)
+    return P(*parts)
+
+
+def parse_rules(text: str) -> List[tuple]:
+    """``MXTPU_PARTITION_RULES`` text -> ordered ``[(regex, spec)]``.
+    Invalid clauses raise MXNetError at parse time (a bad rule fails the
+    bind that consulted it, never silently trains mis-partitioned)."""
+    rules = []
+    for clause in (text or "").split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if "=" not in clause:
+            raise MXNetError(
+                f"MXTPU_PARTITION_RULES clause {clause!r} is not "
+                "'regex=spec'")
+        pat, spec = clause.split("=", 1)
+        try:
+            rx = re.compile(pat.strip())
+        except re.error as e:
+            raise MXNetError(
+                f"MXTPU_PARTITION_RULES regex {pat!r} invalid: {e}")
+        rules.append((rx.pattern, _parse_spec(spec)))
+    return rules
+
+
+def env_rules() -> List[tuple]:
+    """Rules from ``MXTPU_PARTITION_RULES`` ([] when unset)."""
+    from .. import config as _config
+    return parse_rules(str(_config.get("MXTPU_PARTITION_RULES", "") or ""))
+
+
+def spec_for(rules: Sequence[tuple], name: str, ndim: Optional[int] = None,
+             strict: bool = False):
+    """First matching rule's PartitionSpec for ``name`` (re.search, in
+    order). Rank-0 values are always replicated. No match -> P() (or
+    MXNetError when ``strict``)."""
+    from jax.sharding import PartitionSpec as P
+    if ndim == 0:
+        return P()
+    for pat, spec in rules or ():
+        if re.search(pat, name):
+            if ndim is not None and len(spec) > ndim:
+                raise MXNetError(
+                    f"partition rule {pat!r} -> {spec} has more "
+                    f"dimensions than parameter '{name}' (ndim={ndim})")
+            return spec
+    if strict:
+        raise MXNetError(
+            f"no partition rule matches parameter '{name}' — add a "
+            "catch-all '.*=replicated' clause (strict matching refuses "
+            "to silently replicate)")
+    return P()
+
+
+def match_partition_rules(rules: Sequence[tuple], params: Dict[str, object],
+                          strict: bool = True) -> Dict[str, object]:
+    """Resolve a whole named tree: ``{name: array-or-shape}`` ->
+    ``{name: PartitionSpec}`` (SNIPPETS.md [2] semantics — ordered
+    first-match-wins, scalars replicated, unmatched raises in strict
+    mode)."""
+    out = {}
+    for name, v in params.items():
+        shape = tuple(getattr(v, "shape", v if isinstance(v, (tuple, list))
+                              else ()))
+        out[name] = spec_for(rules, name, ndim=len(shape), strict=strict)
+    return out
+
+
+def validate_specs(mesh, specs: Dict[str, object],
+                   shapes: Dict[str, tuple]) -> None:
+    """Every partitioned dimension must divide by its mesh-axis size —
+    checked up front with the parameter's NAME in the error instead of
+    a deep GSPMD shape complaint at compile time."""
+    for name, spec in specs.items():
+        shape = shapes.get(name)
+        if shape is None:
+            continue
+        for d, ax in enumerate(tuple(spec)):
+            if ax is None:
+                continue
+            axes = ax if isinstance(ax, tuple) else (ax,)
+            size = 1
+            for a in axes:
+                size *= int(mesh.shape[a])
+            if d < len(shape) and int(shape[d]) % size:
+                raise MXNetError(
+                    f"parameter '{name}' dim {d} (={shape[d]}) does not "
+                    f"divide mesh axes {axes} (size {size}) — pad the "
+                    "parameter or change the rule")
+
+
+def shard_params(mesh, rules: Sequence[tuple], params: Dict[str, object],
+                 strict: bool = False) -> Dict[str, object]:
+    """device_put every value under its matched rule's NamedSharding
+    (convenience for tests/tools; the fused step applies shardings
+    through its own buffer plumbing)."""
+    import jax
+    from jax.sharding import NamedSharding
+    specs = match_partition_rules(rules, params, strict=strict)
+    validate_specs(mesh, specs,
+                   {n: tuple(getattr(v, "shape", ())) for n, v
+                    in params.items()})
+    return {n: jax.device_put(v, NamedSharding(mesh, specs[n]))
+            for n, v in params.items()}
+
+
+def rules_fingerprint(rules: Sequence[tuple]) -> Optional[list]:
+    """Canonical key material for a rule list (compile/key.py): the
+    ordered (regex, spec-as-strings) pairs. None for no rules, so keys
+    stay byte-identical with pre-partition builds when the feature is
+    off."""
+    if not rules:
+        return None
+    return [(pat, [str(a) for a in tuple(spec)]) for pat, spec in rules]

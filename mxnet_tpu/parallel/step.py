@@ -68,7 +68,8 @@ class TrainStep:
                  optimizer_params=None, mesh: Optional[Mesh] = None,
                  data_axis="data", compute_dtype=None, lr=0.01,
                  lr_schedule: Optional[Callable[[int], float]] = None,
-                 param_spec_fn=None, preprocess=None, remat=None):
+                 param_spec_fn=None, partition_rules=None, preprocess=None,
+                 remat=None):
         """``preprocess``: optional on-device fn applied to the data batch
         inside the compiled step (e.g. uint8 decode -> normalize). Keeps the
         host->device transfer small — the TPU analog of the reference doing
@@ -109,6 +110,21 @@ class TrainStep:
         self._pvals = None
         self._opt_state = None
         self._step_jit = None
+        # declarative alternative to param_spec_fn: regex -> PartitionSpec
+        # rules (parallel/partition.py). Explicit param_spec_fn wins; with
+        # neither, rules come from MXTPU_PARTITION_RULES.
+        if param_spec_fn is None and mesh is not None:
+            from . import partition as _partition
+            rules = (_partition.parse_rules(partition_rules)
+                     if isinstance(partition_rules, str)
+                     else partition_rules)
+            if rules is None:
+                rules = _partition.env_rules()
+            if rules:
+                def param_spec_fn(p, _rules=tuple(rules)):
+                    shape = getattr(p, "shape", None)
+                    ndim = len(shape) if shape else None
+                    return _partition.spec_for(_rules, p.name, ndim=ndim)
         self._param_spec_fn = param_spec_fn
 
     # -- state ----------------------------------------------------------------

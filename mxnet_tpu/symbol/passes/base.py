@@ -24,7 +24,8 @@ from ... import config
 from ..symbol import Symbol, Group, _Node
 
 __all__ = ["GraphPass", "PassContext", "resolve_flag", "flag_active",
-           "rebuild_graph", "parse_node_attrs", "embedding_skip_reason"]
+           "rebuild_graph", "parse_node_attrs", "embedding_skip_reason",
+           "mesh_axis_skip_reason"]
 
 
 def resolve_flag(value) -> str:
@@ -56,10 +57,11 @@ class PassContext:
     casting to bf16 must not be double-cast by the bf16 pass)."""
 
     __slots__ = ("tag", "mode", "mesh", "compute_dtype", "shapes",
-                 "data_names", "symbol")
+                 "data_names", "symbol", "batch_names", "data_axis")
 
     def __init__(self, tag, mode="train", mesh=None, compute_dtype=None,
-                 shapes=None, data_names=None, symbol=None):
+                 shapes=None, data_names=None, symbol=None,
+                 batch_names=None, data_axis="data"):
         self.tag = tag
         self.mode = mode
         self.mesh = mesh
@@ -74,6 +76,13 @@ class PassContext:
         # instead of crashing inside apply/measure on shapes they can't
         # handle (e.g. integer-id embedding inputs)
         self.symbol = symbol
+        # batch-carrying inputs (data + labels) of a MESH bind and the
+        # mesh axis they shard over: the bytes measurement lowers with
+        # these in_shardings so the gate judges the PER-DEVICE program
+        # (round 18 — single-device bytes of an 8-way program would
+        # gate against a number nothing ever runs)
+        self.batch_names = set(batch_names) if batch_names else None
+        self.data_axis = data_axis
 
 
 class GraphPass:
@@ -153,6 +162,23 @@ def embedding_skip_reason(ctx: PassContext) -> Optional[str]:
             has_conv = True
     if has_emb and not has_conv:
         return "embedding_graph"
+    return None
+
+
+def mesh_axis_skip_reason(ctx: PassContext) -> Optional[str]:
+    """Counted skip for mesh binds the shard_map wrapping can't serve:
+    the fused kernels shard over ``ctx.data_axis``, so a mesh without
+    that axis (or a degenerate size-1 axis nobody benefits from
+    re-wrapping) runs the rewrite only if the op can fall back to its
+    unwrapped form — which it can (``_batch_shards`` bails per-site), so
+    this only rejects the truly unsupported case: a mesh that doesn't
+    carry the configured batch axis at all."""
+    mesh = getattr(ctx, "mesh", None)
+    if mesh is None:
+        return None
+    axis = getattr(ctx, "data_axis", "data") or "data"
+    if axis not in getattr(mesh, "shape", {}):
+        return f"mesh_axis:{axis}"
     return None
 
 

@@ -4,9 +4,13 @@ The matcher/rewriter lives unchanged in ``symbol/fusion.py``
 (``fuse_symbol``): BN(+ReLU)→1×1-conv subgraphs substitute the
 ``_FusedBNReLUConv`` Pallas op, with shape-aware tile bail-outs. This
 class is its framework adapter: flag resolution stays on the legacy
-``MXTPU_PALLAS_FUSION`` env var, and mesh binds SKIP (counted by the
-manager — GSPMD cannot partition the opaque Pallas custom call, ROADMAP
-item 1).
+``MXTPU_PALLAS_FUSION`` env var.
+
+Mesh binds FIRE since round 18: the fused op wraps its pallas_call in
+``shard_map`` over the batch axis when a mesh scope is active
+(ops/pallas_fused.py ``mesh_scope``), so the custom call is no longer
+GSPMD-opaque — the manager measures the SHARDED program's per-device
+bytes and gates the rewrite like any other (ROADMAP item 1).
 """
 from __future__ import annotations
 
@@ -18,12 +22,12 @@ __all__ = ["PallasFusionPass"]
 class PallasFusionPass(GraphPass):
     name = "pallas_fusion"
     flag = "MXTPU_PALLAS_FUSION"
-    mesh_safe = False          # GSPMD can't partition the custom call
+    mesh_safe = True           # pallas_call shard_maps over the batch
     modes = ("train", "infer", "serving")
 
     def precheck(self, ctx):
-        from .base import embedding_skip_reason
-        return embedding_skip_reason(ctx)
+        from .base import embedding_skip_reason, mesh_axis_skip_reason
+        return embedding_skip_reason(ctx) or mesh_axis_skip_reason(ctx)
 
     def apply(self, sym, shapes, ctx):
         from ..fusion import fuse_symbol

@@ -130,12 +130,12 @@ def match_bn_relu_conv(sym, shapes, conv_pred):
 class ResidualFusionPass(GraphPass):
     name = "residual_fusion"
     flag = "MXTPU_PASS_RESIDUAL_FUSION"
-    mesh_safe = False          # composes with pallas_fusion's sites;
-    modes = ("train", "infer", "serving")  # mesh fusion is ROADMAP it.1
+    mesh_safe = True           # plain-lax forward + jnp backward: GSPMD
+    modes = ("train", "infer", "serving")  # partitions it natively (r18)
 
     def precheck(self, ctx):
-        from .base import embedding_skip_reason
-        return embedding_skip_reason(ctx)
+        from .base import embedding_skip_reason, mesh_axis_skip_reason
+        return embedding_skip_reason(ctx) or mesh_axis_skip_reason(ctx)
 
     def apply(self, sym, shapes, ctx):
         sites, report = match_bn_relu_conv(sym, shapes,

@@ -143,6 +143,20 @@ def _collect(reset=False):
             "temp_bytes": sum(p.get("temp_bytes", 0) for p in rows),
         },
     }
+    # optimizer-state view (round 18): the fused step's mesh bind
+    # gauges its logical vs per-device optimizer bytes — under ZeRO-1
+    # the per-device number is ~1/N of logical (the roadmap-item-1
+    # reduction this report is the witness for)
+    try:
+        lb = registry.gauge("mem::optimizer::logical_bytes").get()
+        if lb:
+            tree["optimizer"] = {
+                "logical_bytes": lb,
+                "per_device_bytes": registry.gauge(
+                    "mem::optimizer::per_device_bytes").get(),
+            }
+    except Exception:
+        pass
     if reset:
         with _lock:
             _programs.clear()

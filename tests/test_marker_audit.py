@@ -339,3 +339,24 @@ def test_fleet_suite_stays_tier1_with_chaos_marked():
     assert "test_fleet.py" not in uses.get("slow", set()), (
         "test_fleet.py cases must not be slow-marked — the fleet "
         "robustness pins are round-17 acceptance criteria")
+
+
+def test_mesh_training_suite_stays_tier1():
+    """The mesh-training suite is tier-1's only proof that the graph
+    passes fire on mesh binds (the round-18 tentpole), that ZeRO-1 is
+    bit-identical to the replicated update at 1/N optimizer bytes, and
+    that partition rules are compile-key material. It must exist and
+    never carry a ``slow`` mark — everything runs in-process on the
+    conftest's 8 virtual CPU devices in seconds."""
+    path = os.path.join(_TESTS, "test_mesh_training.py")
+    assert os.path.exists(path), "tests/test_mesh_training.py missing"
+    with open(path) as f:
+        src = f.read()
+    m = re.search(r"^pytestmark\s*=.*$", src, re.M)
+    assert m is None or "slow" not in m.group(0), (
+        "test_mesh_training.py must stay tier-1: a module-level slow "
+        "mark drops the mesh-pass and ZeRO-1 pins from the gate")
+    uses = _mark_uses()
+    assert "test_mesh_training.py" not in uses.get("slow", set()), (
+        "test_mesh_training.py cases must not be slow-marked — the "
+        "mesh-native training pins are round-18 acceptance criteria")

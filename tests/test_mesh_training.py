@@ -1,0 +1,404 @@
+"""Mesh-native training (round 18): the fused Module's 8-device path.
+
+- partition rules (parallel/partition.py): ``MXTPU_PARTITION_RULES``
+  parsing, first-match-wins resolution, whole-tree matching, mesh
+  divisibility validation with the parameter's name in the error, and
+  the compile-key fingerprint;
+- shard_map-compatible passes: pallas_fusion/residual_fusion fire on an
+  8-device mesh bind (no ``mesh_bind`` skip), and the measured gate
+  judges the PER-DEVICE program — rewritten mesh bytes strictly below
+  the unrewritten mesh bytes, and the per-device baseline strictly
+  below the single-device baseline of the same graph;
+- ZeRO-1 sharded weight update (MXTPU_ZERO, arXiv:2004.13336):
+  bit-identical parameters vs the replicated oracle, per-replica
+  optimizer bytes exactly 1/N when every dim divides, momentum buffers
+  physically sharded 1/N rows per device, ineligible rules fall back
+  replicated;
+- the partition-rule set is compile-key material: a rule change misses,
+  a mesh-equal rebind hits;
+- gluon TrainStep accepts declarative ``partition_rules`` (kwarg and
+  env) as the regex alternative to ``param_spec_fn``;
+- elastic shrink-world resume re-validates the rules at the re-formed
+  mesh (``prepare_resume(module=...)``) and names the offending
+  parameter when a rule no longer divides.
+
+All cases run on the conftest-forced 8-device virtual CPU platform —
+the same mesh the driver's dryrun and bench.py's ``multichip_fused``
+section use.
+"""
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel import partition as part
+
+NDEV = 8
+
+
+def _ctxs(n=NDEV):
+    return [mx.cpu(i) for i in range(n)]
+
+
+def _mlp_sym(nh=32, ncls=8):
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=nh, name="fc1")
+    act = mx.sym.Activation(data=fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=ncls, name="fc2")
+    return mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def _stripe_data(n=80, ncls=8, dim=16, seed=0):
+    rng = np.random.RandomState(seed)
+    x = np.zeros((n, dim), np.float32)
+    y = rng.randint(0, ncls, n)
+    for i in range(n):
+        x[i, y[i] * (dim // ncls):(y[i] + 1) * (dim // ncls)] = 1.0
+    x += rng.normal(scale=0.3, size=x.shape).astype(np.float32)
+    return x, y.astype(np.float32)
+
+
+def _fit_mlp(zero="auto", opt="sgd", opt_params=None, n_ctx=NDEV,
+             epochs=1):
+    with mx.config.override("MXTPU_ZERO", zero):
+        mx.random.seed(0)
+        x, y = _stripe_data()
+        train = mx.io.NDArrayIter(x, y, batch_size=40)
+        mod = mx.mod.Module(_mlp_sym(), context=_ctxs(n_ctx))
+        mod.fit(train, optimizer=opt,
+                optimizer_params=opt_params or
+                {"learning_rate": 0.5, "momentum": 0.9,
+                 "rescale_grad": 1.0 / 40},
+                num_epoch=epochs)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# partition rules: parsing, matching, validation, fingerprint
+# ---------------------------------------------------------------------------
+def test_partition_rules_parse_and_match():
+    rules = part.parse_rules(
+        r".*dense\d+_weight$=model,*; .*embed.*=data; .*=replicated")
+    assert len(rules) == 3
+    # first re.search match wins, placeholders widen to None
+    assert part.spec_for(rules, "tp_dense0_weight", ndim=2) \
+        == P("model", None)
+    assert part.spec_for(rules, "embed_weight", ndim=2) == P("data")
+    assert part.spec_for(rules, "fc_bias", ndim=1) == P()
+    # rank-0 leaves always replicate, whatever the rule says
+    assert part.spec_for(rules, "tp_dense0_weight", ndim=0) == P()
+    # no rules -> replicated; strict flags the miss
+    assert part.spec_for([], "anything", ndim=2) == P()
+    with pytest.raises(MXNetError):
+        part.spec_for(part.parse_rules("^a$=data"), "b", ndim=1,
+                      strict=True)
+
+
+def test_partition_rules_reject_bad_clauses():
+    for bad in ("noequals", "([=data"):
+        with pytest.raises(MXNetError):
+            part.parse_rules(bad)
+    # an over-ranked spec fails at resolution with the rule + name
+    with pytest.raises(MXNetError, match="more"):
+        part.spec_for(part.parse_rules("w=model,*,*"), "w", ndim=2)
+
+
+def test_match_partition_rules_tree_and_validation():
+    from mxnet_tpu.parallel import make_mesh
+    rules = part.parse_rules(r".*_weight$=model,*")
+    shapes = {"q_weight": (32, 16), "q_bias": (32,), "norm_g": (16,)}
+    specs = part.match_partition_rules(rules, shapes, strict=False)
+    assert specs["q_weight"] == P("model", None)
+    assert specs["q_bias"] == P()
+    mesh = make_mesh({"data": 2, "model": 4})
+    part.validate_specs(mesh, specs, shapes)       # 32 % 4 == 0: fine
+    bad = {"q_weight": (30, 16)}
+    with pytest.raises(MXNetError, match="q_weight"):
+        part.validate_specs(mesh, part.match_partition_rules(
+            rules, bad, strict=False), bad)
+
+
+def test_rules_fingerprint_is_key_material():
+    assert part.rules_fingerprint([]) is None
+    assert part.rules_fingerprint(None) is None
+    fa = part.rules_fingerprint(part.parse_rules(".*w$=model,*"))
+    fb = part.rules_fingerprint(part.parse_rules(".*w$=data,*"))
+    fc = part.rules_fingerprint(part.parse_rules(".*w$=model,*"))
+    assert fa is not None and fa != fb and fa == fc
+
+
+# ---------------------------------------------------------------------------
+# shard_map-compatible passes: fire on the mesh, gate per-device bytes
+# ---------------------------------------------------------------------------
+def _resnet_sym(nf=16, ncls=8):
+    data = mx.sym.Variable("data")
+    x = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1),
+                           num_filter=nf, no_bias=True, name="conv0")
+    bn1 = mx.sym.BatchNorm(x, name="u1_bn1", fix_gamma=False)
+    a1 = mx.sym.Activation(bn1, act_type="relu", name="u1_relu1")
+    c1 = mx.sym.Convolution(a1, kernel=(1, 1), num_filter=nf // 4,
+                            no_bias=True, name="u1_conv1")
+    bn2 = mx.sym.BatchNorm(c1, name="u1_bn2", fix_gamma=False)
+    a2 = mx.sym.Activation(bn2, act_type="relu", name="u1_relu2")
+    c2 = mx.sym.Convolution(a2, kernel=(3, 3), pad=(1, 1),
+                            num_filter=nf // 4, no_bias=True,
+                            name="u1_conv2")
+    bn3 = mx.sym.BatchNorm(c2, name="u1_bn3", fix_gamma=False)
+    a3 = mx.sym.Activation(bn3, act_type="relu", name="u1_relu3")
+    c3 = mx.sym.Convolution(a3, kernel=(1, 1), num_filter=nf,
+                            no_bias=True, name="u1_conv3")
+    x = c3 + x
+    x = mx.sym.Pooling(x, global_pool=True, kernel=(1, 1),
+                       pool_type="avg", name="pool")
+    x = mx.sym.FullyConnected(mx.sym.Flatten(x), num_hidden=ncls,
+                              name="fc")
+    return mx.sym.SoftmaxOutput(x, name="softmax")
+
+
+def _shapes_for(net, data=(16, 8, 8, 8)):
+    kw = {"data": data}
+    if "softmax_label" in net.list_arguments():
+        kw["softmax_label"] = (data[0],)
+    arg_shapes, _, aux_shapes = net.infer_shape(**kw)
+    shapes = dict(zip(net.list_arguments(), arg_shapes))
+    shapes.update(zip(net.list_auxiliary_states(), aux_shapes))
+    return shapes
+
+
+def test_mesh_gate_measures_per_device_bytes():
+    """The measured gate judges the SHARDED program on mesh binds: the
+    rewritten per-device bytes are strictly below the unrewritten
+    per-device bytes, and the per-device baseline is strictly below the
+    single-device baseline of the same graph (the 8-way batch shard)."""
+    from jax.sharding import Mesh
+    from mxnet_tpu.symbol.passes import manager as pm
+    net = _resnet_sym()
+    shapes = _shapes_for(net)
+    mesh = Mesh(np.array(jax.devices()[:NDEV]), ("data",))
+    batch = {"data", "softmax_label"}
+    with mx.config.override("MXTPU_PASS_RESIDUAL_FUSION", "1"), \
+            mx.config.override("MXTPU_PALLAS_FUSION", "0"), \
+            mx.config.override("MXTPU_PASS_BN_FOLD", "0"), \
+            mx.config.override("MXTPU_PASS_BF16", "0"), \
+            mx.config.override("MXTPU_PASS_GATE_BYTES", "1"):
+        final, rep = pm.apply_pipeline(
+            net, shapes, tag="fused_step", mode="train", mesh=mesh,
+            batch_names=batch, data_axis="data")
+        entry = [e for e in rep["passes"]
+                 if e["pass"] == "residual_fusion"][0]
+        assert entry["status"] == "applied", entry
+        assert entry["bytes_before"] and entry["bytes_after"]
+        assert entry["bytes_after"] < entry["bytes_before"]
+        single = pm.measure_symbol_bytes(net, shapes, "train")
+    assert single is not None
+    # per-device program of the 8-way shard moves far fewer bytes than
+    # the whole-batch single-device program
+    assert entry["bytes_before"] < single
+
+
+def test_mesh_fit_applies_passes():
+    """End-to-end: a fused Module fit on 8 devices runs the pipeline —
+    pallas_fusion and residual_fusion apply (no mesh_bind skip) and the
+    step trains to finite parameters."""
+    from mxnet_tpu.telemetry import registry as treg
+    before = treg.counter("passes::skipped::mesh_bind").get()
+    with mx.config.override("MXTPU_PALLAS_FUSION", "1"), \
+            mx.config.override("MXTPU_PASS_RESIDUAL_FUSION", "1"), \
+            mx.config.override("MXTPU_PASS_GATE_BYTES", "0"):
+        mx.random.seed(0)
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 8, 8, 8).astype(np.float32)
+        y = rng.randint(0, 8, 16).astype(np.float32)
+        train = mx.io.NDArrayIter(x, y, batch_size=16)
+        mod = mx.mod.Module(_resnet_sym(), context=_ctxs())
+        mx.pass_report(reset=True)
+        mod.fit(train, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1}, num_epoch=1)
+    rep = mod._fused.pass_report
+    status = {e["pass"]: e["status"] for e in rep["passes"]}
+    assert status["pallas_fusion"] == "applied", status
+    assert status["residual_fusion"] == "applied", status
+    assert treg.counter("passes::skipped::mesh_bind").get() == before
+    arg, _ = mod.get_params()
+    for n, v in arg.items():
+        assert np.isfinite(v.asnumpy()).all(), n
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 sharded weight update
+# ---------------------------------------------------------------------------
+def test_zero1_bit_identical_and_one_over_n():
+    m0 = _fit_mlp("0")
+    m1 = _fit_mlp("1")
+    f0, f1 = m0._fused, m1._fused
+    assert not f0._zero and f1._zero and f1._zero_ndev == NDEV
+    a0, _ = m0.get_params()
+    a1, _ = m1.get_params()
+    for n in sorted(a0):
+        assert np.array_equal(a0[n].asnumpy(), a1[n].asnumpy()), n
+    om0, om1 = f0.optimizer_memory(), f1.optimizer_memory()
+    # every state dim divides 8 here, so the shard is EXACTLY 1/N
+    assert om1["zero"] and om1["ndev"] == NDEV
+    assert om1["per_device_bytes"] == om1["logical_bytes"] // NDEV
+    assert om0["per_device_bytes"] == om0["logical_bytes"]
+    # the reduction is pinned through the memory_report surface too
+    # (m1 bound last, so the gauges carry its regime)
+    opt = mx.memory_report().get("optimizer")
+    assert opt is not None
+    assert opt["logical_bytes"] == om1["logical_bytes"]
+    assert opt["per_device_bytes"] == om1["per_device_bytes"]
+    # momentum buffers are physically sharded: 1/N rows per device
+    big = dict(zip(f1._big_names, f1._opt_state))
+    zb = dict(zip(f1._big_names, f1._zero_big))
+    sharded = 0
+    for n, leaves in big.items():
+        if not zb.get(n):
+            continue
+        for leaf in leaves:
+            if leaf.shape and leaf.shape == \
+                    dict(zip(f1._big_names, f1._pvals))[n].shape:
+                for sh in leaf.addressable_shards:
+                    assert sh.data.shape[0] == leaf.shape[0] // NDEV
+                sharded += 1
+    assert sharded >= 1, "no ZeRO-sharded momentum buffer found"
+
+
+def test_zero1_adam_bit_identical():
+    kw = {"learning_rate": 0.01}
+    a0, _ = _fit_mlp("0", opt="adam", opt_params=kw).get_params()
+    a1, _ = _fit_mlp("1", opt="adam", opt_params=kw).get_params()
+    for n in sorted(a0):
+        assert np.array_equal(a0[n].asnumpy(), a1[n].asnumpy()), n
+
+
+def test_zero1_ineligible_rule_falls_back_replicated():
+    # SGLD needs a PRNG key per update — not an elementwise key-free
+    # rule, so MXTPU_ZERO=1 warns and runs the replicated update
+    m = _fit_mlp("1", opt="sgld", opt_params={"learning_rate": 0.01})
+    assert not m._fused._zero
+    om = m._fused.optimizer_memory()
+    assert om["per_device_bytes"] == om["logical_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# compile key: partition rules are material
+# ---------------------------------------------------------------------------
+def test_partition_rules_are_compile_key_material():
+    rules = r".*fc1_weight$=data,*"
+    k_plain = _fit_mlp()._fused._program_key(("sig",))
+    with mx.config.override("MXTPU_PARTITION_RULES", rules):
+        k_ruled = _fit_mlp()._fused._program_key(("sig",))
+    k_again = _fit_mlp()._fused._program_key(("sig",))
+    # rule change -> miss; mesh-equal rebind with equal config -> hit
+    assert k_plain.digest != k_ruled.digest
+    assert k_plain.digest == k_again.digest
+    assert k_plain.materials.get("partition") is None
+    assert k_ruled.materials.get("partition") is not None
+
+
+# ---------------------------------------------------------------------------
+# gluon TrainStep: declarative partition rules
+# ---------------------------------------------------------------------------
+def test_trainstep_partition_rules_kwarg_and_env():
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import TrainStep, make_mesh
+
+    def make_net(prefix):
+        net = nn.HybridSequential(prefix=prefix)
+        with net.name_scope():
+            net.add(nn.Dense(32, activation="relu"), nn.Dense(10))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    x = np.random.RandomState(0).randn(16, 12).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, (16,))
+    rules = r".*dense0_weight$=model,*"
+
+    mesh = make_mesh({"data": 2, "model": 4})
+    step = TrainStep(make_net("tpr_"), optimizer="adam", lr=0.01,
+                     mesh=mesh, partition_rules=rules)
+    step(x, y)
+    specs = {p.name: v.sharding.spec
+             for p, v in zip(step.param_list, step._pvals)}
+    assert specs["tpr_dense0_weight"] == P("model", None), specs
+    assert specs["tpr_dense1_weight"] == P(), specs
+
+    # same rules through the env var, no kwarg
+    with mx.config.override("MXTPU_PARTITION_RULES", rules):
+        step2 = TrainStep(make_net("tpe_"), optimizer="adam", lr=0.01,
+                          mesh=make_mesh({"data": 2, "model": 4}))
+        step2(x, y)
+    specs2 = {p.name: v.sharding.spec
+              for p, v in zip(step2.param_list, step2._pvals)}
+    assert specs2["tpe_dense0_weight"] == P("model", None), specs2
+
+    # an explicit param_spec_fn wins over rules
+    step3 = TrainStep(make_net("tpw_"), optimizer="adam", lr=0.01,
+                      mesh=make_mesh({"data": 2, "model": 4}),
+                      partition_rules=rules,
+                      param_spec_fn=lambda p: P())
+    step3(x, y)
+    specs3 = {p.name: v.sharding.spec
+              for p, v in zip(step3.param_list, step3._pvals)}
+    assert specs3["tpw_dense0_weight"] == P(), specs3
+
+
+# ---------------------------------------------------------------------------
+# elastic shrink-world: rules re-validated at the re-formed mesh
+# ---------------------------------------------------------------------------
+def test_elastic_shrink_world_revalidates_rules(tmp_path):
+    from mxnet_tpu.parallel import elastic
+    from mxnet_tpu.telemetry import registry as treg
+
+    mgr8 = elastic.ElasticCheckpointManager(
+        str(tmp_path), world=NDEV, rank=0)
+    mod8 = _fit_mlp()
+    mgr8.save_module(mod8, epoch=1)
+    mgr8.wait()
+
+    # re-form at world 4 with rules that still divide: validation is
+    # clean, the cursor restore is disabled, the counter moves
+    x, y = _stripe_data(n=40)
+    train = mx.io.NDArrayIter(x, y, batch_size=20)
+    before = treg.counter("elastic::reshard").get()
+    with mx.config.override("MXTPU_PARTITION_RULES",
+                            r".*fc1_weight$=data,*"):
+        mod4 = _fit_mlp(n_ctx=4)
+        mgr4 = elastic.ElasticCheckpointManager(
+            str(tmp_path), world=4, rank=0)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            state = elastic.prepare_resume(mgr4, train, world=4, rank=0,
+                                           module=mod4)
+    assert state is not None
+    assert (state.extra or {}).get("elastic", {}).get("world") == NDEV
+    assert train.set_state is None          # cursor restore disabled
+    assert any("elastic resume" in str(x.message) for x in w)
+    assert treg.counter("elastic::reshard").get() == before + 1
+
+    # a rule that divided at world 8 but not at the re-formed world
+    # fails fast with the parameter's name (not a GSPMD shape error
+    # deep inside the first post-resume compile)
+    from mxnet_tpu.parallel import make_mesh
+
+    class _Stub:                    # a bound module at the new world
+        mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
+        partition_rules = part.parse_rules(r".*fc1_weight$=data,*")
+
+        @staticmethod
+        def get_params():
+            return ({"fc1_weight": mx.nd.array(
+                np.zeros((30, 16), np.float32))}, {})
+
+    train2 = mx.io.NDArrayIter(x, y, batch_size=20)
+    with pytest.raises(MXNetError, match="fc1_weight"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            elastic.prepare_resume(mgr4, train2, world=4, rank=0,
+                                   module=_Stub())

@@ -47,6 +47,14 @@ FleetRouter's ``fleet_route`` / ``fleet_redispatch`` / ``fleet_shed`` /
 table plus per-request timelines (a request's hops across replicas,
 keyed by its propagated trace id).
 
+Round 18 (mesh-native training): ``diff`` also reads a BENCH file's
+``multichip_fused`` section — per-device step bytes of the 8-device
+fused program and the ZeRO-1 vs replicated optimizer HBM — and under
+``--gate-bytes`` additionally gates the per-device bytes when BOTH
+files carry the section (a baseline predating round 18 reports the new
+reading without gating). Driver-wrapped BENCH files (``{"parsed":
+{...}}`` envelopes) unwrap transparently everywhere.
+
 Pure file-level operations: no accelerator backend is initialized.
 """
 from __future__ import annotations
@@ -201,9 +209,40 @@ def cmd_summary(args):
 # ---------------------------------------------------------------------------
 # diff / bytes-accessed regression gate
 # ---------------------------------------------------------------------------
+def _unwrap_bench(tree):
+    """The driver wraps bench.py's JSON line in ``{"n", "cmd", "rc",
+    "tail", "parsed": {...}}`` — operate on the parsed payload when the
+    envelope is present."""
+    parsed = tree.get("parsed") if isinstance(tree, dict) else None
+    if isinstance(parsed, dict) and ("metric" in parsed
+                                     or "metrics" in parsed):
+        return parsed
+    return tree
+
+
+def _load_multichip(tree):
+    """The BENCH ``multichip_fused`` section's gateable readings, or
+    None when the file predates round 18 (or the section errored)."""
+    mc = tree.get("multichip_fused")
+    if not isinstance(mc, dict) or "dp" not in mc:
+        return None
+    dp = mc.get("dp") or {}
+    hbm = dp.get("optimizer_hbm") or {}
+    return {
+        "per_device_step_bytes": dp.get("per_device_step_bytes"),
+        "zero1_per_device_bytes": hbm.get("zero1_per_device_bytes"),
+        "replicated_per_device_bytes":
+            hbm.get("replicated_per_device_bytes"),
+        "zero1_ratio": hbm.get("zero1_ratio"),
+    }
+
+
 def _load_bytes(tree, path):
-    """bytes-accessed-per-step from a snapshot (metrics gauge) or a
-    BENCH JSON (bench.py's ``xla_bytes_accessed_per_step``)."""
+    """bytes-accessed-per-step from a snapshot (metrics gauge), a
+    BENCH JSON (bench.py's ``xla_bytes_accessed_per_step``), or — for
+    a multichip-only BENCH file (``bench.py multichip_fused``
+    standalone mode, where no single-chip step runs) — the 8-device
+    program's per-device bytes."""
     m = tree.get("metrics", {}).get(BYTES_METRIC)
     if isinstance(m, dict) and m.get("value"):
         return float(m["value"])
@@ -215,9 +254,33 @@ def _load_bytes(tree, path):
         else None
     if isinstance(m, dict) and m.get("value"):
         return float(m["value"])
+    mc = _load_multichip(tree)
+    if mc and mc.get("per_device_step_bytes"):
+        return float(mc["per_device_step_bytes"])
     sys.exit(f"{path}: no {BYTES_METRIC} metric (and no "
-             "xla_bytes_accessed_per_step field) — not a telemetry "
-             "snapshot/BENCH file, or the run recorded no step costs")
+             "xla_bytes_accessed_per_step or multichip_fused field) — "
+             "not a telemetry snapshot/BENCH file, or the run recorded "
+             "no step costs")
+
+
+def _bytes_source(tree):
+    """Which program _load_bytes would read for this file: ``step``
+    (the single-chip train step) or ``multichip`` (the 8-device
+    per-device fallback). Two files with DIFFERENT sources measured
+    different programs — the primary gate records their delta but does
+    not fail on it (the multichip sibling gate handles like-for-like
+    multichip comparisons)."""
+    m = tree.get("metrics", {}).get(BYTES_METRIC)
+    if isinstance(m, dict) and m.get("value"):
+        return "step"
+    if tree.get("xla_bytes_accessed_per_step"):
+        return "step"
+    t = tree.get("telemetry", {})
+    m = t.get("metrics", {}).get(BYTES_METRIC) if isinstance(t, dict) \
+        else None
+    if isinstance(m, dict) and m.get("value"):
+        return "step"
+    return "multichip"
 
 
 def _load_peak_mem(tree, path):
@@ -284,7 +347,7 @@ def cmd_diff(args):
                 trees.append(json.load(f))
         except (OSError, ValueError) as e:
             sys.exit(f"cannot read snapshot {path}: {e}")
-    old_t, new_t = trees
+    old_t, new_t = (_unwrap_bench(t) for t in trees)
     old_v, new_v = _flat_values(old_t), _flat_values(new_t)
     changes = {}
     for name in sorted(set(old_v) | set(new_v)):
@@ -297,8 +360,10 @@ def cmd_diff(args):
         old_b = _load_bytes(old_t, args.old)
         new_b = _load_bytes(new_t, args.new)
         tol = args.tolerance / 100.0
+        src_old, src_new = _bytes_source(old_t), _bytes_source(new_t)
+        comparable = src_old == src_new
         bound = old_b * (1.0 + tol)
-        gate_failed = new_b > bound
+        gate_failed = comparable and new_b > bound
         result["gate_bytes"] = {
             "old_bytes_per_step": old_b,
             "new_bytes_per_step": new_b,
@@ -306,6 +371,29 @@ def cmd_diff(args):
             "tolerance_pct": args.tolerance,
             "regressed": gate_failed,
         }
+        if not comparable:
+            result["gate_bytes"]["note"] = (
+                f"readings measure different programs ({src_old} vs "
+                f"{src_new}) — delta recorded, not gated")
+        # round-18 sibling reading: the 8-device fused program's
+        # per-device bytes. Gated only when BOTH files carry the
+        # multichip_fused section — against a pre-r18 baseline the new
+        # reading is reported ungated (it becomes the baseline)
+        old_mc, new_mc = _load_multichip(old_t), _load_multichip(new_t)
+        if new_mc is not None:
+            entry = dict(new_mc)
+            ob = (old_mc or {}).get("per_device_step_bytes")
+            nb = new_mc.get("per_device_step_bytes")
+            if ob and nb:
+                entry["old_per_device_step_bytes"] = ob
+                entry["delta_pct"] = round((nb / ob - 1.0) * 100.0, 4)
+                entry["regressed"] = nb > ob * (1.0 + tol)
+                gate_failed = gate_failed or entry["regressed"]
+            else:
+                entry["regressed"] = False
+                entry["baseline"] = "no multichip_fused section in "\
+                    f"{args.old} (pre-r18) — reading recorded, not gated"
+            result["gate_bytes_multichip"] = entry
     mem_failed = False
     if args.gate_peak_mem:
         old_m = _load_peak_mem(old_t, args.old)
@@ -345,7 +433,25 @@ def cmd_diff(args):
             print(f"bytes/step: {g['old_bytes_per_step']:.6g} -> "
                   f"{g['new_bytes_per_step']:.6g} "
                   f"({g['delta_pct']:+.3f}%, tolerance "
-                  f"{args.tolerance}%)")
+                  f"{args.tolerance}%)"
+                  + (f" [{g['note']}]" if g.get("note") else ""))
+            mc = result.get("gate_bytes_multichip")
+            if mc:
+                if "old_per_device_step_bytes" in mc:
+                    print(f"multichip per-device bytes/step: "
+                          f"{mc['old_per_device_step_bytes']:.6g} -> "
+                          f"{mc['per_device_step_bytes']:.6g} "
+                          f"({mc['delta_pct']:+.3f}%)")
+                else:
+                    print(f"multichip per-device bytes/step: "
+                          f"{mc['per_device_step_bytes']:.6g} "
+                          "(new baseline, ungated)")
+                if mc.get("zero1_ratio") is not None:
+                    print(f"multichip ZeRO-1 optimizer bytes/replica: "
+                          f"{mc['zero1_per_device_bytes']:.6g} vs "
+                          f"replicated "
+                          f"{mc['replicated_per_device_bytes']:.6g} "
+                          f"(ratio {mc['zero1_ratio']})")
         if args.gate_peak_mem:
             g = result["gate_peak_mem"]
             print(f"peak HBM: {g['old_peak_bytes']:.6g} -> "
@@ -358,13 +464,23 @@ def cmd_diff(args):
                   f"{g['new_shed_rate']:.6g} (tolerance "
                   f"{args.tolerance}%)")
     if gate_failed:
-        print(f"BYTES REGRESSION: {BYTES_METRIC} grew "
-              f"{result['gate_bytes']['delta_pct']:+.3f}% (> "
-              f"{args.tolerance}% tolerance) — the step moves MORE "
-              "HBM bytes than the baseline snapshot; in the "
-              "bandwidth-bound regime that is a throughput regression "
-              "(ROADMAP item 2's currency). Fix the pass or re-baseline "
-              "deliberately.", file=sys.stderr)
+        if result["gate_bytes"]["regressed"]:
+            print(f"BYTES REGRESSION: {BYTES_METRIC} grew "
+                  f"{result['gate_bytes']['delta_pct']:+.3f}% (> "
+                  f"{args.tolerance}% tolerance) — the step moves MORE "
+                  "HBM bytes than the baseline snapshot; in the "
+                  "bandwidth-bound regime that is a throughput "
+                  "regression (ROADMAP item 2's currency). Fix the "
+                  "pass or re-baseline deliberately.", file=sys.stderr)
+        mc = result.get("gate_bytes_multichip") or {}
+        if mc.get("regressed"):
+            print("BYTES REGRESSION (multichip): the 8-device fused "
+                  f"program's per-device bytes grew "
+                  f"{mc['delta_pct']:+.3f}% (> {args.tolerance}% "
+                  "tolerance) — the sharded train step moves more HBM "
+                  "per chip than the baseline (a mesh-pass or "
+                  "partitioning regression). Fix it or re-baseline "
+                  "deliberately.", file=sys.stderr)
     if mem_failed:
         print(f"PEAK-MEM REGRESSION: {PEAK_MEM_METRIC} grew "
               f"{result['gate_peak_mem']['delta_pct']:+.3f}% (> "
