@@ -158,6 +158,211 @@ def transformer_serving(clients_list=(1, 8, 64)):
     }
 
 
+def quantized_serving(clients_list=(1, 8)):
+    """The r19 quantization section, both measured deliverables:
+
+    1. int8 weight PTQ on the serving path — a conv tower calibrated
+       (``mx.quant.calibrate``) and served through the Predictor with
+       the ``int8_ptq`` pass on vs off: img/s, per-bucket XLA
+       bytes-accessed of the compiled predict program (the quantized
+       one must be strictly below), and the eval-accuracy cost (class
+       agreement vs the f32 predictor, pinned within
+       MXTPU_QUANT_ACC_TOL).
+    2. int8 KV-cache decode — the pocket transformer LM served through
+       the continuous batcher with MXTPU_DECODE_KV_DTYPE int8 vs
+       float32: tok/s, TTFT/ITL p99, decode-step bytes, cache
+       footprint (~0.31x f32 at head_dim 16), greedy-token agreement
+       vs the f32 cache (the perplexity proxy: greedy decode diverges
+       the moment any step's argmax flips), and the bit-identity of
+       quantized batched vs quantized solo streams.
+
+    ``serving_bytes_ratio`` / ``decode_step_bytes_ratio`` baseline
+    ``tools/telemetry.py diff --gate-bytes`` (round-19 block)."""
+    import contextlib
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import quant as Q
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving import loadgen
+    from mxnet_tpu.serving.decode import (
+        TransformerLMSpec, DecodePredictor, DecodeBatcher, init_params)
+
+    # -- deliverable 1: int8 PTQ serving A/B on a conv tower -----------------
+    feat = (8, 16, 16)
+    buckets = (4, 8)
+    data = mx.sym.Variable("data")
+    cur = data
+    for i in range(2):
+        bn = mx.sym.BatchNorm(cur, name=f"qb_bn{i}", fix_gamma=False)
+        act = mx.sym.Activation(bn, act_type="relu", name=f"qb_relu{i}")
+        cur = mx.sym.Convolution(act, kernel=(3, 3), num_filter=16,
+                                 pad=(1, 1), no_bias=True,
+                                 name=f"qb_conv{i}")
+    fc = mx.sym.FullyConnected(mx.sym.Flatten(cur), num_hidden=10,
+                               name="qb_fc")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+    mod = mx.mod.Module(context=mx.cpu(), symbol=net)
+    mod.bind(data_shapes=[("data", (8,) + feat)],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+
+    rng = np.random.RandomState(0)
+    calib = [{"data": rng.rand(8, *feat).astype(np.float32),
+              "softmax_label": rng.randint(0, 10, (8,)).astype(
+                  np.float32)} for _ in range(4)]
+    qcfg = Q.calibrate(mod, calib, observer="absmax")
+
+    def _predictor(quantize):
+        scope = Q.quant_scope(qcfg) if quantize \
+            else contextlib.nullcontext()
+        with scope, mx.config.override(
+                "MXTPU_PASS_INT8_PTQ", "1" if quantize else "0"):
+            pred = mod.as_predictor(buckets=buckets)
+            pred.warmup()
+        per_bucket = {
+            str(b): float(pred.program_cost(b).get("bytes accessed",
+                                                   0.0)) or None
+            for b in buckets}
+        return pred, per_bucket
+
+    pred_q, bytes_q = _predictor(True)
+    pred_f, bytes_f = _predictor(False)
+    ptq_sites = sum(len(e["sites"])
+                    for e in pred_q.pass_report["passes"]
+                    if e["pass"] == "int8_ptq"
+                    and e["status"] == "applied")
+
+    # eval accuracy cost: class agreement with the f32 predictor over a
+    # held-out synthetic set (f32's own predictions as labels -> the
+    # f32 accuracy is 1.0 by construction and the delta IS the cost)
+    xe = rng.rand(256, *feat).astype(np.float32)
+    cls_f, cls_q = [], []
+    for i in range(0, 256, 8):
+        cls_f.append(np.argmax(np.asarray(pred_f.predict(xe[i:i + 8])),
+                               axis=-1))
+        cls_q.append(np.argmax(np.asarray(pred_q.predict(xe[i:i + 8])),
+                               axis=-1))
+    agreement = float(np.mean(np.concatenate(cls_f) ==
+                              np.concatenate(cls_q)))
+    acc_tol = float(mx.config.get("MXTPU_QUANT_ACC_TOL", 0.02))
+
+    # throughput of the quantized predictor behind the batcher
+    with serving.DynamicBatcher(pred_q, max_wait_us=1000,
+                                max_queue=4096,
+                                name="bench-quant") as bat:
+        x1 = rng.rand(1, *feat).astype(np.float32)
+        bat.predict(x1)
+        run = loadgen.closed_loop(bat, x1, clients=8, per_client=8)
+    top = str(max(buckets))
+    serving_ratio = (bytes_q[top] / bytes_f[top]
+                     if bytes_q.get(top) and bytes_f.get(top) else None)
+
+    # -- deliverable 2: int8 KV-cache decode A/B -----------------------------
+    spec = TransformerLMSpec(vocab_size=256, num_embed=64, num_heads=4,
+                             num_layers=2, max_seq=64, name="qbenchlm")
+    params = init_params(spec, seed=0)
+    engines = {}
+    for kvd in ("float32", "int8"):
+        eng = DecodePredictor(spec, params, slots=8, seq_buckets=(16, 32),
+                              kv_dtype=kvd, name=f"qbenchlm-{kvd}")
+        eng.warmup()
+        engines[kvd] = eng
+    prompts = [rng.randint(1, spec.vocab_size, size=4 + (i * 5) % 16
+                           ).astype(np.int32) for i in range(16)]
+    per_client = {1: 8, 8: 3}
+    decode_runs = {}
+    for kvd, eng in engines.items():
+        runs = {}
+        with DecodeBatcher(eng, max_wait_us=2000, max_queue=4096,
+                           name=f"bench-q-{kvd}") as dbat:
+            for n in clients_list:
+                r = loadgen.token_closed_loop(
+                    dbat, prompts, n, per_client.get(n, 1),
+                    max_new_tokens=16)
+                runs[str(n)] = {
+                    "tok_s": round(r["tok_s"], 2),
+                    "ttft_p99_ms": round(r["ttft_p99_ms"], 3),
+                    "inter_token_p99_ms": round(
+                        r["inter_token_p99_ms"], 3),
+                }
+        decode_runs[kvd] = runs
+    dec_f = float(engines["float32"].program_cost("decode").get(
+        "bytes accessed", 0.0)) or None
+    dec_q = float(engines["int8"].program_cost("decode").get(
+        "bytes accessed", 0.0)) or None
+    kv_f = engines["float32"].kv_cache_bytes()
+    kv_q = engines["int8"].kv_cache_bytes()
+
+    # greedy-token agreement f32 vs int8 cache (the perplexity proxy),
+    # and quantized batched-vs-solo bit-identity
+    gen_prompts = prompts[:4]
+    n_new = 12
+    solo = {kvd: [list(eng.generate(p, max_new_tokens=n_new))
+                  for p in gen_prompts]
+            for kvd, eng in engines.items()}
+    flat_f = [t for s in solo["float32"] for t in s]
+    flat_q = [t for s in solo["int8"] for t in s]
+    token_agreement = float(np.mean(np.asarray(flat_f) ==
+                                    np.asarray(flat_q)))
+    eng_q = engines["int8"]
+    slots, cur_tok, batched_toks = [], {}, {}
+    for p in gen_prompts:
+        s = eng_q.alloc_slot()
+        nxt = eng_q.prefill(s, p)
+        slots.append(s)
+        cur_tok[s] = nxt
+        batched_toks[s] = [nxt]
+    for _ in range(n_new - 1):
+        nxt = eng_q.decode(cur_tok)
+        for s, t in nxt.items():
+            batched_toks[s].append(t)
+            cur_tok[s] = t
+    for s in slots:
+        eng_q.release(s)
+    batched_equals_solo = all(
+        batched_toks[s] == solo["int8"][i]
+        for i, s in enumerate(slots))
+
+    return {
+        "ptq_sites": ptq_sites,
+        "calibrated_layers": len(qcfg.layers),
+        "enabled_layers": len(qcfg.enabled_layers()),
+        "granularity": qcfg.granularity,
+        "img_s": round(run["rows_s"], 2),
+        "serving_bytes_per_bucket_int8": bytes_q,
+        "serving_bytes_per_bucket_f32": bytes_f,
+        "serving_bytes_ratio": round(serving_ratio, 4)
+        if serving_ratio else None,
+        "eval_class_agreement": round(agreement, 4),
+        "eval_acc_delta": round(1.0 - agreement, 4),
+        "acc_tolerance": acc_tol,
+        "accuracy_ok": (1.0 - agreement) <= acc_tol,
+        "decode": decode_runs,
+        "decode_step_bytes_f32": dec_f,
+        "decode_step_bytes_int8": dec_q,
+        "decode_step_bytes_ratio": round(dec_q / dec_f, 4)
+        if dec_f and dec_q else None,
+        "kv_cache_bytes_f32": kv_f,
+        "kv_cache_bytes_int8": kv_q,
+        "kv_cache_ratio": round(kv_q / kv_f, 4) if kv_f else None,
+        "lm_token_agreement": round(token_agreement, 4),
+        "batched_equals_solo_int8": bool(batched_equals_solo),
+        "note": "int8 PTQ (mxnet_tpu/quant/ + the int8_ptq pass): "
+                "serving_bytes_per_bucket compare the compiled predict "
+                "program with quantization on vs off — int8 weights "
+                "hoist as program arguments and the dequantize fuses "
+                "into the conv, so the quantized program must move "
+                "strictly fewer XLA bytes; the decode A/B serves the "
+                "same LM with the KV-cache stored int8+per-row-f32-"
+                "scale vs f32 (MXTPU_DECODE_KV_DTYPE) — "
+                "kv_cache_ratio ~ 0.25+1/head_dim, lm_token_agreement "
+                "is greedy-token agreement vs the f32 cache, and "
+                "batched_equals_solo_int8 pins that per-row scales "
+                "keep continuous-batching lanes bit-identical to solo "
+                "decode under quantization",
+    }
+
+
 def fleet_serving(replicas_list=(1, 2, 4)):
     """The r17 fleet-robustness section: a pocket MLP served through
     the self-healing FleetRouter (serving/fleet.py). Headlines: router
@@ -1178,6 +1383,13 @@ print("BENCH " + json.dumps({
     except Exception:
         pass
 
+    # -- quantization (round 19): int8 PTQ serving + int8 KV decode
+    quantized_serving_stats = None
+    try:
+        quantized_serving_stats = quantized_serving()
+    except Exception:
+        pass
+
     # -- fleet serving (round 17): router overhead, replica scaling,
     # drain latency, shed-rate baseline
     fleet_serving_stats = None
@@ -1301,6 +1513,7 @@ print("BENCH " + json.dumps({
         "sparse_embedding": sparse_stats,
         "autotune": autotune_stats,
         "transformer_serving": transformer_serving_stats,
+        "quantized_serving": quantized_serving_stats,
         "fleet_serving": fleet_serving_stats,
         "multichip_fused": multichip_stats,
         "memory": memory_stats,
@@ -1329,6 +1542,11 @@ if __name__ == "__main__":
         print("BENCH " + json.dumps(
             {"metric": "transformer_serving",
              "transformer_serving": transformer_serving()}))
+    elif len(sys.argv) > 1 and sys.argv[1] == "quantized_serving":
+        # standalone fast mode: just the quantization section
+        print("BENCH " + json.dumps(
+            {"metric": "quantized_serving",
+             "quantized_serving": quantized_serving()}))
     elif len(sys.argv) > 1 and sys.argv[1] == "fleet_serving":
         # standalone fast mode: just the fleet-robustness section
         print("BENCH " + json.dumps(

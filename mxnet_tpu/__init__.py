@@ -78,6 +78,8 @@ from . import sparse
 from .sparse import sparse_report
 from . import tune
 from .tune import tune_report
+from . import quant
+from .quant import quant_report
 from . import contrib
 from . import gluon
 from . import rnn

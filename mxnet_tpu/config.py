@@ -312,6 +312,40 @@ register("MXTPU_ZERO", "auto", str,
          "all-gather. Bit-identical to the replicated update. "
          "auto/1 = on when the optimizer is an elementwise key-free "
          "rule and the data axis has >1 device; 0 = replicated update")
+register("MXTPU_PASS_INT8_PTQ", "auto", str,
+         "Post-training int8 weight quantization pass for eval-mode "
+         "programs (symbol/passes/int8_ptq.py): rewrites conv/dense "
+         "weights to int8 with per-channel f32 scales from the ambient "
+         "mx.quant calibration config. 1/0 force, auto = on for TPU "
+         "backends; a no-op without an active QuantConfig (counted "
+         "skip no_quant_config)")
+register("MXTPU_QUANT_GRANULARITY", "per_channel", str,
+         "Default quantization granularity for mx.quant.calibrate: "
+         "per_channel (one scale per output channel, the accuracy "
+         "posture) or per_tensor (one scale per weight tensor — fewer "
+         "scale bytes, coarser clipping; the r15 quant workload "
+         "searches both)")
+register("MXTPU_QUANT_DENSE", "auto", str,
+         "Let int8_ptq quantize FullyConnected weights too (1/0 force, "
+         "auto = on for TPU backends). Off-TPU the XLA dot emitter "
+         "does not fuse the int8->f32 dequant into the matmul, so "
+         "int8 dense weights MOVE MORE BYTES than f32 — the measured "
+         "gate rejects the rewrite; conv sites fuse everywhere and "
+         "stay quantized regardless")
+register("MXTPU_QUANT_ACC_TOL", 0.02, float,
+         "Calibration accuracy guard (mx.quant.calibrate): a layer "
+         "whose simulated-quant output error (relative L2 vs f32 over "
+         "the calibration batches) exceeds this tolerance is DISABLED "
+         "in the QuantConfig instead of shipped wrong; tools/quant.py "
+         "verify gates end-to-end accuracy against the same number")
+register("MXTPU_DECODE_KV_DTYPE", "float32", str,
+         "KV-cache storage dtype for decode serving (serving/decode/): "
+         "float32 or int8. int8 stores each cache row quantized with a "
+         "per-(slot,position,head) f32 absmax scale and dequantizes at "
+         "f32 compute in-program — ~0.31x the cache HBM at head_dim "
+         "16, the decode step moves measurably fewer bytes, and "
+         "continuous batching stays bit-identical to solo decode. "
+         "Cache layout/dtype is compile-key material")
 
 
 def _autostart_profiler():

@@ -1,0 +1,274 @@
+"""Post-training calibration: weights + data -> ``QuantConfig``.
+
+``calibrate(module, data_iter)`` is the user entry point: it walks the
+module's symbol for quantizable sites (Convolution / FullyConnected
+nodes whose weight is a bound parameter), runs the configured observer
+over each weight, and — when a calibration iterator is supplied —
+replays the batches through the graph twice (f32 vs simulated-quant
+weights) to measure the end-to-end output error the quantization would
+introduce. Everything is host-side numpy and deterministic: the same
+module + iterator always yield byte-identical JSON.
+
+The accuracy guard is per-layer: a layer whose weight-space relative L2
+error exceeds ``tolerance`` (``MXTPU_QUANT_ACC_TOL``) is DISABLED in
+the config — shipped exact rather than shipped wrong — and the reason
+is recorded. The ``int8_ptq`` pass only rewrites enabled layers.
+
+The config is AMBIENT for the pass pipeline: ``set_config`` /
+``quant_scope`` install it process-wide, the pass reads
+``current_config()`` at apply time and counts a ``no_quant_config``
+skip when none is installed (which is why every pre-r19 test and
+program is untouched by the new pass).
+"""
+from __future__ import annotations
+
+import json
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .observers import (make_observer, compute_scales, quantize_np,
+                        dequantize_np)
+
+__all__ = ["QuantConfig", "calibrate", "find_sites", "set_config",
+           "current_config", "quant_scope"]
+
+_QUANT_OPS = {"Convolution": "conv", "Convolution_v1": "conv",
+              "FullyConnected": "fc"}
+
+
+class QuantConfig:
+    """Per-layer quantization decisions, keyed by the op node's BASE
+    name (pass-era renames like ``{conv}__bnfold`` are stripped at
+    lookup, so the config survives the bn_fold rewrite)."""
+
+    def __init__(self, layers: Optional[Dict[str, dict]] = None,
+                 granularity: str = "per_channel",
+                 observer: str = "percentile",
+                 tolerance: float = 0.02):
+        self.layers = dict(layers or {})
+        self.granularity = granularity
+        self.observer = observer
+        self.tolerance = float(tolerance)
+        self.model_error = None
+
+    def lookup(self, name: str) -> Optional[dict]:
+        if name.endswith("__bnfold"):
+            name = name[: -len("__bnfold")]
+        return self.layers.get(name)
+
+    def enabled_layers(self) -> List[str]:
+        return [n for n, e in self.layers.items() if e.get("enabled")]
+
+    def to_dict(self) -> dict:
+        return {"granularity": self.granularity,
+                "observer": self.observer,
+                "tolerance": self.tolerance,
+                "model_error": self.model_error,
+                "layers": self.layers}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "QuantConfig":
+        cfg = cls(layers=d.get("layers", {}),
+                  granularity=d.get("granularity", "per_channel"),
+                  observer=d.get("observer", "percentile"),
+                  tolerance=d.get("tolerance", 0.02))
+        cfg.model_error = d.get("model_error")
+        return cfg
+
+    @classmethod
+    def from_json(cls, text: str) -> "QuantConfig":
+        return cls.from_dict(json.loads(text))
+
+    def save(self, path: str):
+        with open(path, "w") as f:
+            f.write(self.to_json() + "\n")
+
+    @classmethod
+    def load(cls, path: str) -> "QuantConfig":
+        with open(path) as f:
+            return cls.from_json(f.read())
+
+
+# ---------------------------------------------------------------------
+# ambient config (what the int8_ptq pass reads at apply time)
+
+_ACTIVE: List[Optional[QuantConfig]] = [None]
+
+
+def set_config(cfg: Optional[QuantConfig]) -> Optional[QuantConfig]:
+    """Install ``cfg`` as the process-wide quantization config;
+    returns the previous one (pass ``None`` to clear)."""
+    prev = _ACTIVE[0]
+    _ACTIVE[0] = cfg
+    return prev
+
+
+def current_config() -> Optional[QuantConfig]:
+    return _ACTIVE[0]
+
+
+@contextmanager
+def quant_scope(cfg: Optional[QuantConfig]):
+    """Scoped ``set_config`` — the idiomatic way to stage a quantized
+    Predictor: ``with mx.quant.quant_scope(cfg): pred = mod.as_predictor(...)``."""
+    prev = set_config(cfg)
+    try:
+        yield cfg
+    finally:
+        set_config(prev)
+
+
+# ---------------------------------------------------------------------
+# site discovery + calibration
+
+def find_sites(sym) -> List[Tuple[object, str, str]]:
+    """Quantizable sites of a PRE-pipeline symbol: ``(node, kind,
+    weight_var_name)`` for every conv/FC whose weight input is a plain
+    variable (composite or derived weights calibrate after their own
+    rewrites, at pass time, not here)."""
+    out = []
+    for n in sym._topo_nodes():
+        kind = _QUANT_OPS.get(n.op)
+        if kind is None or len(n.inputs) < 2:
+            continue
+        w, wi = n.inputs[1]
+        if w.op is None and wi == 0:
+            out.append((n, kind, w.name))
+    return out
+
+
+def _resolve_symbol_params(module):
+    def _np(d):
+        # Module.get_params() hands back NDArrays; the observers and
+        # the eval_arrays_ex error probe both want host numpy
+        # (np.asarray alone would produce a dtype=object scalar wrapper)
+        return {k: np.asarray(v.asnumpy() if hasattr(v, "asnumpy")
+                              else v)
+                for k, v in (d or {}).items()}
+
+    if isinstance(module, tuple) and len(module) == 2:
+        sym, params = module
+        return sym, _np(params), {}
+    sym = getattr(module, "symbol", None)
+    if sym is None or not hasattr(module, "get_params"):
+        raise TypeError(
+            "calibrate() wants a bound Module (or a (symbol, params) "
+            f"tuple); got {type(module).__name__}")
+    arg_params, aux_params = module.get_params()
+    return sym, _np(arg_params), _np(aux_params)
+
+
+def _batch_feed(batch, data_names) -> Dict[str, np.ndarray]:
+    if isinstance(batch, dict):
+        return {k: np.asarray(v) for k, v in batch.items()}
+    data = getattr(batch, "data", None)
+    if data is not None:
+        feed = {}
+        label = getattr(batch, "label", None) or []
+        vals = list(data) + list(label)
+        for name, v in zip(data_names, vals):
+            feed[name] = np.asarray(v)
+        return feed
+    if isinstance(batch, (list, tuple)):
+        return {n: np.asarray(v) for n, v in zip(data_names, batch)}
+    return {data_names[0]: np.asarray(batch)}
+
+
+def calibrate(module, data_iter=None, observer: Optional[str] = None,
+              granularity: Optional[str] = None, percentile: float = 99.9,
+              tolerance: Optional[float] = None,
+              max_batches: int = 8) -> QuantConfig:
+    """Calibrate ``module`` for int8 weight PTQ; returns a
+    ``QuantConfig`` ready for ``quant_scope``.
+
+    ``module``: a bound, initialized Module — or a ``(symbol,
+    {name: array})`` tuple. ``data_iter``: optional iterable of
+    calibration batches (dicts, DataBatches, arrays); used to measure
+    the f32-vs-simulated-quant output error recorded as
+    ``model_error``. ``observer``: ``"percentile"`` (default) or
+    ``"absmax"``; ``granularity``: ``"per_channel"`` /
+    ``"per_tensor"`` (default ``MXTPU_QUANT_GRANULARITY``);
+    ``tolerance``: per-layer weight-error guard (default
+    ``MXTPU_QUANT_ACC_TOL``)."""
+    from .. import config as _config
+    from ..telemetry import registry as _treg
+
+    sym, arg_params, aux_params = _resolve_symbol_params(module)
+    if granularity is None:
+        granularity = str(_config.get("MXTPU_QUANT_GRANULARITY",
+                                      "per_channel")).strip().lower()
+    if granularity not in ("per_channel", "per_tensor"):
+        raise ValueError(f"unknown granularity: {granularity!r}")
+    if tolerance is None:
+        tolerance = float(_config.get("MXTPU_QUANT_ACC_TOL", 0.02))
+    obs_kind = (observer or "percentile").strip().lower()
+    per_channel = granularity == "per_channel"
+
+    cfg = QuantConfig(granularity=granularity, observer=obs_kind,
+                      tolerance=tolerance)
+    qweights: Dict[str, np.ndarray] = {}
+    for node, kind, wname in find_sites(sym):
+        w = arg_params.get(wname)
+        if w is None:
+            continue
+        w = np.asarray(w, dtype=np.float32)
+        ob = make_observer(obs_kind, per_channel=per_channel,
+                           percentile=percentile).observe(w)
+        frac = float(ob.clip_fraction())
+        scale = compute_scales(w, per_channel=per_channel,
+                               clip_fraction=frac)
+        deq = dequantize_np(quantize_np(w, scale), scale)
+        denom = float(np.linalg.norm(w.reshape(-1)))
+        err = float(np.linalg.norm((deq - w).reshape(-1)) /
+                    max(denom, 1e-12))
+        enabled = err <= tolerance
+        cfg.layers[node.name] = {
+            "name": node.name, "kind": kind, "weight": wname,
+            "granularity": granularity, "observer": obs_kind,
+            "clip_fraction": frac,
+            "absmax": float(np.max(ob.absmax())),
+            "scales": [float(s) for s in scale.reshape(-1)],
+            "error": err, "enabled": bool(enabled),
+            "reason": "" if enabled else
+            f"weight error {err:.6f} > tolerance {tolerance:g}",
+        }
+        if enabled:
+            qweights[wname] = deq
+
+    # end-to-end error over the calibration batches: the same program,
+    # f32 weights vs simulated-quant weights, relative L2 on outputs
+    if data_iter is not None and qweights:
+        data_names = [a for a in sym.list_arguments()
+                      if a not in arg_params]
+        base = dict(arg_params)
+        base.update(aux_params)
+        errs = []
+        for bi, batch in enumerate(data_iter):
+            if bi >= max_batches:
+                break
+            feed = _batch_feed(batch, data_names)
+            amap = dict(base)
+            amap.update(feed)
+            outs_f, _ = sym.eval_arrays_ex(amap, training=False)
+            amap_q = dict(amap)
+            amap_q.update(qweights)
+            outs_q, _ = sym.eval_arrays_ex(amap_q, training=False)
+            for of, oq in zip(outs_f, outs_q):
+                of = np.asarray(of, dtype=np.float32).reshape(-1)
+                oq = np.asarray(oq, dtype=np.float32).reshape(-1)
+                errs.append(float(np.linalg.norm(oq - of) /
+                                  max(float(np.linalg.norm(of)), 1e-12)))
+        if errs:
+            cfg.model_error = float(np.mean(errs))
+
+    _treg.counter("quant::calibrations").inc()
+    _treg.counter("quant::layers_total").inc(len(cfg.layers))
+    _treg.counter("quant::layers_enabled").inc(len(cfg.enabled_layers()))
+    if cfg.model_error is not None:
+        _treg.gauge("quant::model_error").set(cfg.model_error)
+    return cfg

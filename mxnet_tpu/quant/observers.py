@@ -1,0 +1,142 @@
+"""Calibration observers and the numpy quantization oracle.
+
+The observers are pure numpy — calibration is a host-side, deterministic
+analysis (no RNG, no device state), so the same module + iterator always
+produce the same ``QuantConfig`` and the scale math here doubles as the
+test oracle for the in-graph rewrite (tests/test_quant.py compares the
+``int8_ptq`` pass's hoisted int8 weights against ``quantize_np``).
+
+Two observers, per the classic PTQ split:
+
+- ``AbsMaxObserver`` — symmetric absmax: the scale covers the full
+  range of the tensor, nothing saturates, coarse under outliers.
+- ``PercentileObserver`` — clips at the ``percentile``-th percentile of
+  |w|; the handful of outlier weights saturate to ±127 and everything
+  else gets a finer grid. The clip is carried as a scalar per-layer
+  ``clip_fraction`` (clip point / global absmax) so the graph rewrite
+  can re-derive the exact scale from the CURRENT weights (absmax ·
+  clip_fraction / 127) — a reloaded checkpoint re-quantizes itself
+  without a stale scale constant baked into the graph.
+
+Granularity: ``per_channel`` reduces over every axis except the output
+channel (axis 0 for both conv ``(O,I,kh,kw)`` and FullyConnected
+``(O,I)`` weights), keepdims so the scale broadcasts back; ``per_tensor``
+reduces everything to one scalar scale.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["AbsMaxObserver", "PercentileObserver", "make_observer",
+           "compute_scales", "quantize_np", "dequantize_np",
+           "QMAX", "SCALE_FLOOR"]
+
+QMAX = 127.0
+# floor keeps an all-zero channel from producing scale 0 → div-by-zero;
+# the graph rewrite applies the same floor via _maximum_scalar
+SCALE_FLOOR = 1e-12
+
+
+def _reduce_axes(ndim: int, per_channel: bool, channel_axis: int = 0):
+    if not per_channel:
+        return tuple(range(ndim))
+    return tuple(i for i in range(ndim) if i != channel_axis)
+
+
+class AbsMaxObserver:
+    """Symmetric absmax observer; ``clip_fraction`` is always 1.0."""
+
+    kind = "absmax"
+
+    def __init__(self, per_channel: bool = True, channel_axis: int = 0):
+        self.per_channel = bool(per_channel)
+        self.channel_axis = int(channel_axis)
+        self._absmax = None
+
+    def observe(self, arr):
+        arr = np.asarray(arr, dtype=np.float32)
+        axes = _reduce_axes(arr.ndim, self.per_channel, self.channel_axis)
+        m = np.max(np.abs(arr), axis=axes, keepdims=True)
+        self._absmax = m if self._absmax is None \
+            else np.maximum(self._absmax, m)
+        return self
+
+    def absmax(self):
+        if self._absmax is None:
+            raise ValueError("observer has seen no data")
+        return self._absmax
+
+    def clip_fraction(self) -> float:
+        return 1.0
+
+    def scales(self):
+        return np.maximum(
+            self.absmax() * (self.clip_fraction() / QMAX),
+            SCALE_FLOOR).astype(np.float32)
+
+
+class PercentileObserver(AbsMaxObserver):
+    """Clips at the ``percentile``-th percentile of |w| (whole tensor).
+
+    The fraction is scalar per layer — the graph rewrite applies it to
+    the per-channel absmax, so per-channel granularity still gets
+    per-channel scales with one shared saturation posture.
+    """
+
+    kind = "percentile"
+
+    def __init__(self, percentile: float = 99.9, per_channel: bool = True,
+                 channel_axis: int = 0):
+        super().__init__(per_channel=per_channel, channel_axis=channel_axis)
+        self.percentile = float(percentile)
+        self._clip = None
+
+    def observe(self, arr):
+        super().observe(arr)
+        a = np.abs(np.asarray(arr, dtype=np.float32)).reshape(-1)
+        c = float(np.percentile(a, self.percentile))
+        self._clip = c if self._clip is None else max(self._clip, c)
+        return self
+
+    def clip_fraction(self) -> float:
+        gmax = float(np.max(self.absmax()))
+        if self._clip is None or gmax <= 0.0:
+            return 1.0
+        return min(1.0, max(self._clip / gmax, SCALE_FLOOR))
+
+
+def make_observer(kind: str, per_channel: bool = True,
+                  percentile: float = 99.9) -> AbsMaxObserver:
+    k = str(kind).strip().lower()
+    if k == "absmax":
+        return AbsMaxObserver(per_channel=per_channel)
+    if k == "percentile":
+        return PercentileObserver(percentile=percentile,
+                                  per_channel=per_channel)
+    raise ValueError(f"unknown observer kind: {kind!r} "
+                     "(expected 'absmax' or 'percentile')")
+
+
+def compute_scales(w, per_channel: bool = True, clip_fraction: float = 1.0,
+                   channel_axis: int = 0):
+    """Scale tensor exactly as the in-graph rewrite derives it:
+    ``max(absmax · clip_fraction / 127, floor)`` with keepdims so it
+    broadcasts against the weight."""
+    w = np.asarray(w, dtype=np.float32)
+    axes = _reduce_axes(w.ndim, per_channel, channel_axis)
+    amax = np.max(np.abs(w), axis=axes, keepdims=True)
+    return np.maximum(amax * (float(clip_fraction) / QMAX),
+                      SCALE_FLOOR).astype(np.float32)
+
+
+def quantize_np(w, scale):
+    """int8 weights under half-away-from-zero rounding — the symbol
+    ``round`` op's convention (``sign·floor(|x|+0.5)``), NOT numpy's
+    banker's rounding, so the oracle matches the graph bit-for-bit."""
+    q = np.asarray(w, dtype=np.float32) / np.asarray(scale, np.float32)
+    q = np.sign(q) * np.floor(np.abs(q) + 0.5)
+    return np.clip(q, -QMAX, QMAX).astype(np.int8)
+
+
+def dequantize_np(q, scale):
+    return q.astype(np.float32) * np.asarray(scale, np.float32)

@@ -13,12 +13,16 @@ program families iterative decode needs (model.py):
   doesn't depend on which other slots are occupied: the other half.
 
 The KV-cache is DONATED device state: ``2 * num_layers`` buffers of
-``(slots, max_seq, heads, head_dim)`` float32 threaded through every
-call (donated back to XLA where the backend supports donation —
-``compile.donation_supported()``), never copied to host. Cache layout,
-``max_seq`` and ``slots`` are compile-key material, and the accounted
-cache footprint is recorded in ``mx.memory_report()`` next to the
-per-program peaks so cache sizing is driven by measured HBM headroom.
+``(slots, max_seq, heads, head_dim)`` float32 — or, under
+``MXTPU_DECODE_KV_DTYPE=int8``, ``4 * num_layers`` int8 value +
+per-row f32 scale buffers (model.py, round 19) — threaded through
+every call (donated back to XLA where the backend supports donation —
+``compile.donation_supported()``), never copied to host. Cache layout
+AND dtype, ``max_seq`` and ``slots`` are compile-key material, and the
+accounted cache footprint is recorded in ``mx.memory_report()`` next
+to the per-program peaks so cache sizing is driven by measured HBM
+headroom — under int8 the decode_state row drops to ~0.31× f32, which
+is the "roughly double the slots per chip" capacity lever.
 
 Programs go through the r10 compile registry (``load_or_compile`` +
 ``note_entry_point``): AOT persistent-cache warm starts, retrace
@@ -85,10 +89,15 @@ class DecodePredictor:
         clipped to ``spec.max_seq`` which is always included).
     name : str, optional
         Label for programs/telemetry (default ``spec.name``).
+    kv_dtype : str, optional
+        Cache storage dtype, ``"float32"`` or ``"int8"`` (default
+        ``MXTPU_DECODE_KV_DTYPE``). int8 stores per-row absmax scales
+        and dequantizes at f32 compute (model.py); the layout is
+        compile-key material, so flipping it is a program miss.
     """
 
     def __init__(self, spec, params, slots=None, seq_buckets=None,
-                 name=None):
+                 name=None, kv_dtype=None):
         import jax
         import jax.numpy as jnp
         from ... import compile as compile_mod
@@ -99,6 +108,9 @@ class DecodePredictor:
             else int(config.get("MXTPU_DECODE_SLOTS", 4))
         if self.slots < 1:
             raise MXNetError(f"slots={self.slots} must be >= 1")
+        self.kv_dtype = _model.check_kv_dtype(
+            kv_dtype if kv_dtype is not None
+            else config.get("MXTPU_DECODE_KV_DTYPE", "float32"))
         self.buckets = tuple(sorted(set(
             int(b) for b in seq_buckets))) if seq_buckets \
             else default_seq_buckets(spec.max_seq)
@@ -124,23 +136,23 @@ class DecodePredictor:
         self._pnames = spec.param_names()
         self._pvals_t = tuple(pvals[n] for n in self._pnames)
 
-        cache_shape = (self.slots, spec.max_seq, spec.num_heads,
-                       spec.head_dim)
         self._caches = tuple(
-            jax.device_put(jnp.zeros(cache_shape, jnp.float32))
-            for _ in range(2 * spec.num_layers))
+            jax.device_put(c) for c in _model.init_caches(
+                spec, self.slots, kv_dtype=self.kv_dtype))
 
         pnames = list(self._pnames)
+        kv_dtype_s = self.kv_dtype
 
         def prefill_fn(pvals_t, caches, tokens, length, slot):
             p = dict(zip(pnames, pvals_t))
             return _model.prefill_step(spec, p, caches, tokens, length,
-                                       slot)
+                                       slot, kv_dtype=kv_dtype_s)
 
         def decode_fn(pvals_t, caches, tokens, positions, active):
             p = dict(zip(pnames, pvals_t))
             return _model.decode_step(spec, p, caches, tokens,
-                                      positions, active)
+                                      positions, active,
+                                      kv_dtype=kv_dtype_s)
 
         def reprefill_fn(pvals_t, tokens, length):
             p = dict(zip(pnames, pvals_t))
@@ -239,10 +251,11 @@ class DecodePredictor:
     def _program_key(self, kind, bucket=None):
         from ... import compile as compile_mod
         extra = dict(self.spec.key_material())
+        layout = ("slot-major:int8+f32scale" if self.kv_dtype == "int8"
+                  else "slot-major:f32")
         extra.update({
             "slots": self.slots,
-            "cache_layout": "slot-major:f32"
-            if kind != "reprefill" else "none",
+            "cache_layout": layout if kind != "reprefill" else "none",
             "donate": self._donate and kind != "reprefill",
         })
         sigs = ((("tokens", (1, bucket), "int32"),)
@@ -441,8 +454,9 @@ class DecodePredictor:
     # -- measured-gate surfaces ----------------------------------------------
     def kv_cache_bytes(self):
         """ACTUAL cache footprint (sum of live buffer nbytes); equals
-        ``spec.kv_cache_bytes(slots)`` — tests pin both against the
-        memory_report() row."""
+        ``spec.kv_cache_bytes(slots, kv_dtype)`` — tests pin both
+        against the memory_report() row (~0.31× f32 under int8 at the
+        default head_dim 16)."""
         return int(sum(int(c.nbytes) for c in self._caches))
 
     def program_cost(self, kind, bucket=None):
@@ -493,8 +507,12 @@ class DecodePredictor:
                 "prefills": self._prefills,
                 "decode_steps": self._decode_steps,
                 "tokens": self._tokens,
+                "kv_dtype": self.kv_dtype,
                 "kv_cache_bytes": self.kv_cache_bytes(),
                 "kv_cache_accounted_bytes":
+                    self.spec.kv_cache_bytes(self.slots,
+                                             kv_dtype=self.kv_dtype),
+                "kv_cache_f32_bytes":
                     self.spec.kv_cache_bytes(self.slots),
                 "decode_bytes_per_token": self.decode_bytes_per_token(),
                 "donate": self._donate,

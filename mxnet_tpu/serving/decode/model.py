@@ -23,6 +23,22 @@ beyond a slot's position are masked with the ring-attention ``-1e30``
 convention, whose contribution underflows to an exact 0.0 — stale
 bytes can never perturb the stream, which is what makes continuous
 batching bit-identical to solo decode.
+
+Quantized cache (round 19, ``kv_dtype="int8"``): each K/V buffer
+splits into an int8 value buffer plus a float32 scale buffer of shape
+``(slots, max_seq, num_heads)`` — one symmetric absmax scale PER CACHE
+ROW (slot, position, head), so the cache costs ``head_dim + 4`` bytes
+per row instead of ``4·head_dim`` (0.25 + 1/head_dim of f32; 0.3125×
+at the default head_dim 16). Rows quantize on write and the whole
+cache dequantizes at f32 compute on read — XLA fuses the
+convert-and-scale into the attention einsum's cache read, so the
+decode step also MOVES fewer bytes, not just resides in fewer.
+Per-row scales keep slot lanes fully independent (a lane's scales
+never depend on other lanes' rows), so quantized continuous batching
+stays bit-identical to quantized solo decode: the r16 pin holds under
+int8. Stale-row scale entries are garbage like stale values — both
+are masked to an exact 0.0 contribution by the same ``-1e30``
+convention.
 """
 from __future__ import annotations
 
@@ -30,10 +46,22 @@ import numpy as np
 
 from ...base import MXNetError
 
-__all__ = ["TransformerLMSpec", "build_symbol", "init_params"]
+__all__ = ["TransformerLMSpec", "build_symbol", "init_params",
+           "init_caches", "KV_DTYPES"]
 
 _NEG = -1e30
 _LN_EPS = 1e-5
+_KV_SCALE_FLOOR = 1e-12
+KV_DTYPES = ("float32", "int8")
+
+
+def check_kv_dtype(kv_dtype):
+    kd = str(kv_dtype).strip().lower()
+    if kd not in KV_DTYPES:
+        raise MXNetError(
+            f"kv_dtype={kv_dtype!r} not supported (one of {KV_DTYPES}; "
+            "set via MXTPU_DECODE_KV_DTYPE)")
+    return kd
 
 
 class TransformerLMSpec:
@@ -91,13 +119,19 @@ class TransformerLMSpec:
             "max_seq": self.max_seq, "ffn": self.ffn_hidden,
         }
 
-    def kv_cache_bytes(self, slots):
-        """Accounted KV-cache footprint for ``slots`` generation slots:
-        layers × {K,V} × slots × max_seq × heads × head_dim × f32.
-        Tests pin this against the live buffers' actual nbytes and
-        ``memory_report()`` shows it next to per-program peaks."""
-        return (self.num_layers * 2 * int(slots) * self.max_seq
-                * self.num_heads * self.head_dim * 4)
+    def kv_cache_bytes(self, slots, kv_dtype="float32"):
+        """Accounted KV-cache footprint for ``slots`` generation slots.
+        f32: layers × {K,V} × slots × max_seq × heads × head_dim × 4.
+        int8: each row costs ``head_dim`` int8 bytes plus one f32
+        per-row scale — ``head_dim + 4`` per row, 0.25 + 1/head_dim of
+        the f32 cache. Tests pin this against the live buffers' actual
+        nbytes and ``memory_report()`` shows it next to per-program
+        peaks."""
+        rows = (self.num_layers * 2 * int(slots) * self.max_seq
+                * self.num_heads)
+        if check_kv_dtype(kv_dtype) == "int8":
+            return rows * (self.head_dim + 4)
+        return rows * self.head_dim * 4
 
 
 def build_symbol(spec, seq_len, name="softmax"):
@@ -172,9 +206,49 @@ def init_params(spec, seed=0, scale=0.02):
     return out
 
 
+def init_caches(spec, slots, kv_dtype="float32"):
+    """Fresh zeroed cache buffers for ``slots`` lanes. f32: per layer
+    ``[K, V]`` of (slots, max_seq, H, D) float32. int8: per layer
+    ``[Kq, Kscale, Vq, Vscale]`` — int8 values plus (slots, max_seq, H)
+    float32 per-row scales. The flat tuple is the donated device state
+    threaded through prefill/decode."""
+    import jax.numpy as jnp
+    kd = check_kv_dtype(kv_dtype)
+    vshape = (int(slots), spec.max_seq, spec.num_heads, spec.head_dim)
+    out = []
+    for _ in range(spec.num_layers):
+        for _kv in range(2):
+            if kd == "int8":
+                out.append(jnp.zeros(vshape, jnp.int8))
+                out.append(jnp.zeros(vshape[:3], jnp.float32))
+            else:
+                out.append(jnp.zeros(vshape, jnp.float32))
+    return tuple(out)
+
+
 # ---------------------------------------------------------------------------
 # pure-jnp serving math (jitted by engine.py)
 # ---------------------------------------------------------------------------
+
+def _kv_quant_rows(rows):
+    """Quantize fresh K/V rows ``(..., H, D)`` → (int8 rows, f32
+    per-row scales ``(..., H)``): symmetric absmax over head_dim. The
+    floor keeps an all-zero row's scale finite; with ``scale ≥
+    absmax/127`` the rounded values can never exceed ±127, the clip is
+    belt-and-braces."""
+    import jax.numpy as jnp
+    amax = jnp.max(jnp.abs(rows), axis=-1)
+    scale = jnp.maximum(amax * (1.0 / 127.0), _KV_SCALE_FLOOR)
+    q = jnp.clip(jnp.round(rows / scale[..., None]), -127.0, 127.0)
+    return q.astype(jnp.int8), scale
+
+
+def _kv_dequant(q, scale):
+    """f32 view of a quantized cache buffer; XLA fuses the convert and
+    the broadcast multiply into the consuming einsum's cache read."""
+    import jax.numpy as jnp
+    return q.astype(jnp.float32) * scale[..., None]
+
 
 def _ln(x, gamma, beta):
     import jax
@@ -209,19 +283,25 @@ def _head(spec, p, x_last):
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits
 
 
-def prefill_step(spec, p, caches, tokens, length, slot):
+def prefill_step(spec, p, caches, tokens, length, slot,
+                 kv_dtype="float32"):
     """Fill one slot's KV rows from a padded prompt; emit token #1.
 
     tokens: (1, Sb) int32 padded prompt (Sb = static seq bucket);
     length: () int32 true prompt length; slot: () int32. caches: tuple
-    of 2*layers buffers (slots, max_seq, H, D). Returns
-    ``(caches', next_token)``. Rows [length, Sb) hold pad K/V — decode
-    overwrites position ``length`` first and masks beyond its position,
-    so they are unreachable (see module docstring).
+    of 2*layers buffers (slots, max_seq, H, D) — 4*layers
+    value+scale buffers under ``kv_dtype="int8"`` (``init_caches``).
+    Returns ``(caches', next_token)``. Rows [length, Sb) hold pad K/V —
+    decode overwrites position ``length`` first and masks beyond its
+    position, so they are unreachable (see module docstring). Prefill
+    attention runs on the EXACT f32 k/v of this prompt; only the rows
+    WRITTEN are quantized — identically on the solo and batched paths,
+    so bit-identity is unaffected.
     """
     import jax.numpy as jnp
     from jax import lax
 
+    int8_kv = check_kv_dtype(kv_dtype) == "int8"
     sb = tokens.shape[1]
     scale = 1.0 / (spec.head_dim ** 0.5)
     x = p["tok_emb_weight"][tokens[0]] + p["pos_emb_weight"][:sb]
@@ -231,13 +311,24 @@ def prefill_step(spec, p, caches, tokens, length, slot):
         h = _ln(x, p[f"l{i}_ln1_gamma"], p[f"l{i}_ln1_beta"])
         qkv = h @ p[f"l{i}_qkv_weight"].T
         q, k, v = _split_qkv(qkv, spec.num_heads, spec.head_dim)
-        kc = lax.dynamic_update_slice(
-            caches[2 * i], k[None].astype(caches[2 * i].dtype),
-            (slot, 0, 0, 0))
-        vc = lax.dynamic_update_slice(
-            caches[2 * i + 1], v[None].astype(caches[2 * i + 1].dtype),
-            (slot, 0, 0, 0))
-        new_caches += [kc, vc]
+        if int8_kv:
+            kq, ks, vq, vs = caches[4 * i: 4 * i + 4]
+            kqi, ksc = _kv_quant_rows(k)
+            vqi, vsc = _kv_quant_rows(v)
+            new_caches += [
+                lax.dynamic_update_slice(kq, kqi[None], (slot, 0, 0, 0)),
+                lax.dynamic_update_slice(ks, ksc[None], (slot, 0, 0)),
+                lax.dynamic_update_slice(vq, vqi[None], (slot, 0, 0, 0)),
+                lax.dynamic_update_slice(vs, vsc[None], (slot, 0, 0))]
+        else:
+            kc = lax.dynamic_update_slice(
+                caches[2 * i], k[None].astype(caches[2 * i].dtype),
+                (slot, 0, 0, 0))
+            vc = lax.dynamic_update_slice(
+                caches[2 * i + 1],
+                v[None].astype(caches[2 * i + 1].dtype),
+                (slot, 0, 0, 0))
+            new_caches += [kc, vc]
         s = jnp.einsum("qhd,khd->hqk", q, k) * scale
         s = jnp.where(causal[None], s, _NEG)
         m = jnp.max(s, axis=-1, keepdims=True)
@@ -250,7 +341,8 @@ def prefill_step(spec, p, caches, tokens, length, slot):
     return tuple(new_caches), nxt
 
 
-def decode_step(spec, p, caches, tokens, positions, active):
+def decode_step(spec, p, caches, tokens, positions, active,
+                kv_dtype="float32"):
     """Advance every active slot by ONE token against the cache.
 
     tokens: (slots,) int32 each slot's previous token; positions:
@@ -258,11 +350,15 @@ def decode_step(spec, p, caches, tokens, positions, active):
     write index); active: (slots,) bool. Inactive slots compute garbage
     that writes nowhere (drop-mode scatter at the ``max_seq`` sentinel)
     and is discarded by the caller. Each slot's lane is independent —
-    batched output rows equal solo output rows bit-for-bit.
-    Returns ``(caches', next_tokens (slots,) int32)``.
+    batched output rows equal solo output rows bit-for-bit; under
+    ``kv_dtype="int8"`` the new row quantizes before the scatter and
+    attention reads the dequantized cache, both per-lane, so the
+    identity survives quantization. Returns ``(caches', next_tokens
+    (slots,) int32)``.
     """
     import jax.numpy as jnp
 
+    int8_kv = check_kv_dtype(kv_dtype) == "int8"
     n = tokens.shape[0]
     scale = 1.0 / (spec.head_dim ** 0.5)
     sidx = jnp.arange(n)
@@ -275,11 +371,23 @@ def decode_step(spec, p, caches, tokens, positions, active):
         h = _ln(x, p[f"l{i}_ln1_gamma"], p[f"l{i}_ln1_beta"])
         qkv = h @ p[f"l{i}_qkv_weight"].T
         q, k, v = _split_qkv(qkv, spec.num_heads, spec.head_dim)
-        kc = caches[2 * i].at[sidx, wpos].set(
-            k.astype(caches[2 * i].dtype), mode="drop")
-        vc = caches[2 * i + 1].at[sidx, wpos].set(
-            v.astype(caches[2 * i + 1].dtype), mode="drop")
-        new_caches += [kc, vc]
+        if int8_kv:
+            kq, ks, vq, vs = caches[4 * i: 4 * i + 4]
+            kqi, ksc = _kv_quant_rows(k)
+            vqi, vsc = _kv_quant_rows(v)
+            kq = kq.at[sidx, wpos].set(kqi, mode="drop")
+            ks = ks.at[sidx, wpos].set(ksc, mode="drop")
+            vq = vq.at[sidx, wpos].set(vqi, mode="drop")
+            vs = vs.at[sidx, wpos].set(vsc, mode="drop")
+            new_caches += [kq, ks, vq, vs]
+            kc = _kv_dequant(kq, ks)
+            vc = _kv_dequant(vq, vs)
+        else:
+            kc = caches[2 * i].at[sidx, wpos].set(
+                k.astype(caches[2 * i].dtype), mode="drop")
+            vc = caches[2 * i + 1].at[sidx, wpos].set(
+                v.astype(caches[2 * i + 1].dtype), mode="drop")
+            new_caches += [kc, vc]
         s = jnp.einsum("nhd,nmhd->nhm", q, kc) * scale
         s = jnp.where(visible[:, None, :], s, _NEG)
         m = jnp.max(s, axis=-1, keepdims=True)

@@ -21,8 +21,11 @@ Default pipeline (each pass behind its own env flag; 1/0 force,
 3. ``bn_fold`` (``MXTPU_PASS_BN_FOLD``) — inference-time constant-fold
    of Conv→BN into the conv weights/bias (the BN disappears from the
    serving program).
-4. ``bf16_cast`` (``MXTPU_PASS_BF16``) — bf16 activation traffic
-   around convolutions, fp32 master params.
+4. ``int8_ptq`` (``MXTPU_PASS_INT8_PTQ``) — int8 weight PTQ from the
+   ambient ``mx.quant`` calibration config; after bn_fold so the
+   FOLDED weights quantize, a no-op (counted skip) without a config.
+5. ``bf16_cast`` (``MXTPU_PASS_BF16``) — bf16 activation traffic
+   around convolutions, fp32 master params; bails on quantized convs.
 
 ``MXTPU_PASS_GATE_BYTES`` controls the measured gate (auto: gate
 auto-enabled passes, trust forced ones). ``pass_report()`` (telemetry
@@ -39,6 +42,7 @@ from .manager import (PassManager, apply_pipeline, default_manager,
 from .pallas_fusion import PallasFusionPass
 from .residual_fusion import ResidualFusionPass
 from .bn_fold import BNFoldPass
+from .int8_ptq import Int8PTQPass
 from .bf16_cast import Bf16CastPass
 
 __all__ = ["GraphPass", "PassContext", "PassManager", "apply_pipeline",
@@ -47,4 +51,4 @@ __all__ = ["GraphPass", "PassContext", "PassManager", "apply_pipeline",
            "pipeline_key_material", "reset_measure_memo",
            "rebuild_graph", "resolve_flag",
            "flag_active", "PallasFusionPass", "ResidualFusionPass",
-           "BNFoldPass", "Bf16CastPass"]
+           "BNFoldPass", "Int8PTQPass", "Bf16CastPass"]

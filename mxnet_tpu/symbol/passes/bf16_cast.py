@@ -70,6 +70,14 @@ class Bf16CastPass(GraphPass):
                 report["bailouts"].append({"conv": node.name,
                                            "reason": reason})
 
+            if "__quantized__" in node.attrs:
+                # int8_ptq already rewrote this conv: its weight path is
+                # int8→f32-dequant and its compute stays f32 by design —
+                # stacking bf16 casts would narrow the dequantized
+                # weights a second time (the r19 ordering pin)
+                bail("conv is int8-quantized — bf16 would double-cast "
+                     "the dequantized weights")
+                continue
             if "__input_names__" in node.attrs or \
                     len(node.inputs) not in (2, 3):
                 bail("Convolution with non-standard inputs")

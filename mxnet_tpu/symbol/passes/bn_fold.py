@@ -74,6 +74,15 @@ class BNFoldPass(GraphPass):
                     {"conv": conv.name, "bn": node.name,
                      "reason": reason})
 
+            if "__quantized__" in conv.attrs:
+                # folding BN scales into an int8-quantized weight would
+                # silently requantize it under stale scales; bail LOUDLY
+                # — the pipeline order (bn_fold BEFORE int8_ptq) makes
+                # this unreachable unless someone re-runs the pipeline
+                # over an already-rewritten graph (the r19 ordering pin)
+                bail("conv is int8-quantized — folding would silently "
+                     "requantize (run bn_fold before int8_ptq)")
+                continue
             if "__input_names__" in node.attrs or len(node.inputs) != 5:
                 bail("BatchNorm with non-standard inputs")
                 continue

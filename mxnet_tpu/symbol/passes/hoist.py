@@ -47,7 +47,15 @@ def hoist_plan(sym, data_names: Sequence[str]
         if n.op is None:
             const[id(n)] = n.name not in data
         else:
+            # A node carrying ``__no_hoist__`` is a hoist BARRIER: it and
+            # everything downstream stay in the residual program even when
+            # all transitive inputs are parameters. int8_ptq plants it on
+            # the dequantize Cast so the program argument is the int8
+            # weight — hoisting past it would precompute the f32 dequant
+            # and hand the program full-width weights again (zero byte
+            # savings). Its param-only INPUTS still hoist normally.
             const[id(n)] = bool(n.inputs) and \
+                "__no_hoist__" not in n.attrs and \
                 all(const[id(p)] for p, _ in n.inputs)
     keys: List[tuple] = []
     seen = set()

@@ -1,0 +1,211 @@
+"""int8 post-training weight quantization as a graph pass.
+
+Rewrites conv/dense weights of an eval-mode program to int8 with
+per-channel (or per-tensor) f32 scales, per the ambient
+``mx.quant.QuantConfig`` (quant/calibrate.py). The rewrite is the
+Relay-style quantize-as-graph-rewrite (arXiv:1810.00952) hosted in the
+r12 pass framework, and its whole value is in how it composes with the
+Predictor's parameter-expression hoisting:
+
+    w ──abs──max──·(clip/127)──max(floor)──► scale        (param-only)
+    w ──/scale──round──clip──Cast(int8)───► wq            (param-only)
+    wq ──Cast(f32) [__no_hoist__] ──·scale──► conv/dense  (residual)
+
+Everything above the barrier is parameter-only, so hoisting
+(passes/hoist.py) precomputes it ONCE at staging: the compiled serving
+program's arguments are the INT8 weight and the small f32 scale — a 4×
+cut in weight traffic — while the ``__no_hoist__`` barrier on the
+dequantize Cast pins the f32 expansion inside the program, where XLA
+fuses it into the convolution's weight read. Scales are derived
+in-graph from the CURRENT weights (absmax · clip_fraction/127), so a
+reloaded checkpoint re-quantizes itself at the next staging; only the
+calibrated ``clip_fraction`` posture is baked in.
+
+Dense sites are gated by ``MXTPU_QUANT_DENSE`` (auto = on-for-TPU):
+measured on the CPU XLA backend, the dot emitter does NOT fuse the
+int8→f32 convert into a plain (m>1) matmul — the converted f32 copy
+materializes and int8 dense weights move MORE bytes than f32 — while
+conv and batched-einsum reads fuse everywhere tested. The pass
+manager's measured bytes gate remains the arbiter either way.
+
+Composition hardening (the r19 adversarial pins): runs AFTER bn_fold
+(quantizing the folded weight expression — the config lookup strips
+the ``__bnfold`` rename) and BEFORE bf16_cast, which bails on
+``__quantized__`` convs; if bf16_cast is somehow forced first, this
+pass refuses to quantize a weight already cast below f32 instead of
+double-casting.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from ... import config
+from ..symbol import _Node
+from .base import (GraphPass, parse_node_attrs, rebuild_graph,
+                   resolve_flag, flag_active, embedding_skip_reason)
+
+__all__ = ["Int8PTQPass"]
+
+_CONV_OPS = ("Convolution", "Convolution_v1")
+_DENSE_OPS = ("FullyConnected",)
+_SUB_F32 = ("float16", "bfloat16")
+
+
+def dense_quant_active() -> bool:
+    """MXTPU_QUANT_DENSE: quantize FullyConnected weights too. ``auto``
+    = on-for-TPU — off-TPU the XLA dot emitter materializes the
+    dequantized f32 weight copy (measured: int8 dense moves MORE
+    bytes), so CPU runs must force it and eat the gate rejection."""
+    return flag_active(resolve_flag(config.get("MXTPU_QUANT_DENSE",
+                                               "auto")))
+
+
+class Int8PTQPass(GraphPass):
+    name = "int8_ptq"
+    flag = "MXTPU_PASS_INT8_PTQ"
+    mesh_safe = True      # elementwise weight algebra; GSPMD partitions it
+    modes = ("infer", "serving")
+
+    def precheck(self, ctx):
+        reason = embedding_skip_reason(ctx)
+        if reason:
+            return reason
+        from ...quant import current_config
+        if current_config() is None:
+            # quantization is opt-in via calibration: without an
+            # installed QuantConfig every bind stays byte-identical to
+            # pre-r19 — counted, so "why didn't it quantize" is
+            # answerable from pass_report()
+            return "no_quant_config"
+        return None
+
+    def apply(self, sym, shapes, ctx):
+        from ...quant import current_config
+        from ...quant.observers import SCALE_FLOOR, QMAX
+        cfg = current_config()
+        report = {"sites": [], "bailouts": []}
+        if cfg is None:
+            return None, report
+        dense_on = dense_quant_active()
+
+        _, node_shapes = sym._propagate_shapes(dict(shapes))
+        nodes = sym._topo_nodes()
+        # param-only reachability (the hoist.py rule): a quantize
+        # subgraph built over a data-dependent "weight" would run per
+        # call AND read the f32 weight — no byte win, numerics change
+        data = set(ctx.data_names or ())
+        const: Dict[int, bool] = {}
+        for n in nodes:
+            if n.op is None:
+                const[id(n)] = n.name not in data
+            else:
+                const[id(n)] = bool(n.inputs) and \
+                    "__no_hoist__" not in n.attrs and \
+                    all(const[id(p)] for p, _ in n.inputs)
+
+        sites: Dict[int, dict] = {}
+        for node in nodes:
+            if node.op in _CONV_OPS:
+                kind = "conv"
+            elif node.op in _DENSE_OPS:
+                kind = "fc"
+            else:
+                continue
+            entry = cfg.lookup(node.name)
+            if entry is None:
+                continue          # not calibrated — not this pass's site
+
+            def bail(reason):
+                report["bailouts"].append(
+                    {"site": node.name, "kind": kind, "reason": reason})
+
+            if not entry.get("enabled", False):
+                bail("disabled by calibration: " +
+                     (entry.get("reason") or "?"))
+                continue
+            if "__quantized__" in node.attrs:
+                bail("already quantized")
+                continue
+            if kind == "fc" and not dense_on:
+                bail("dense quantization off (MXTPU_QUANT_DENSE): the "
+                     "dot emitter here materializes the dequantized "
+                     "f32 copy")
+                continue
+            if "__input_names__" in node.attrs or len(node.inputs) < 2:
+                bail(f"{node.op} with non-standard inputs")
+                continue
+            wp, wpi = node.inputs[1]
+            if wp.op in ("Cast", "cast"):
+                wdt = str(parse_node_attrs(wp).get("dtype", "float32"))
+                if wdt in _SUB_F32:
+                    # bf16_cast ran first (forced order): quantizing a
+                    # bf16 weight would stack a second narrowing cast
+                    bail(f"weight already cast to {wdt} — refusing to "
+                         "double-cast (run int8_ptq before bf16_cast)")
+                    continue
+            if not const.get(id(wp), False) and wp.op is not None:
+                bail("weight input is data-dependent — nothing to hoist")
+                continue
+            wshape = node_shapes.get((id(wp), wpi))
+            if not wshape:
+                bail("weight shape unknown")
+                continue
+            gran = str(entry.get("granularity",
+                                 cfg.granularity)).strip().lower()
+            if gran == "per_channel":
+                axes = tuple(range(1, len(wshape)))
+            else:
+                axes = tuple(range(len(wshape)))
+            if not axes:
+                bail("weight rank too low for channel scales")
+                continue
+            frac = float(entry.get("clip_fraction", 1.0))
+            sites[id(node)] = {"kind": kind, "axes": axes, "frac": frac,
+                               "floor": SCALE_FLOOR, "qmax": QMAX}
+            report["sites"].append({
+                "site": node.name, "kind": kind, "granularity": gran,
+                "clip_fraction": frac, "weight_shape": tuple(wshape)})
+        if not sites:
+            return None, report
+
+        def build_anchor(node, m, map_out, outmap):
+            base = node.name
+
+            def mk(op, suffix, inputs, attrs=None):
+                return _Node(op, f"{base}__q_{suffix}",
+                             attrs=attrs or {},
+                             inputs=[(n, i) for n, i in inputs])
+
+            w_in = map_out(*node.inputs[1])
+            absw = mk("abs", "abs", [w_in])
+            amax = mk("max", "amax", [(absw, 0)],
+                      {"axis": m["axes"], "keepdims": True})
+            sc0 = mk("_mul_scalar", "sc0", [(amax, 0)],
+                     {"scalar": m["frac"] / m["qmax"]})
+            scale = mk("_maximum_scalar", "scale", [(sc0, 0)],
+                       {"scalar": m["floor"]})
+            qdiv = mk("broadcast_div", "div", [w_in, (scale, 0)])
+            qround = mk("round", "round", [(qdiv, 0)])
+            qclip = mk("clip", "clip", [(qround, 0)],
+                       {"a_min": -m["qmax"], "a_max": m["qmax"]})
+            wq = mk("Cast", "int8", [(qclip, 0)], {"dtype": "int8"})
+            # the hoist BARRIER: everything upstream (wq, scale) is
+            # param-only and becomes a precomputed program argument;
+            # the f32 expansion below stays in the program, where XLA
+            # fuses it into the consumer's weight read
+            deq = mk("Cast", "deq", [(wq, 0)],
+                     {"dtype": "float32", "__no_hoist__": "1"})
+            wfull = mk("broadcast_mul", "wfull",
+                       [(deq, 0), (scale, 0)])
+            new_inputs = [map_out(*node.inputs[0]), (wfull, 0)]
+            new_inputs += [map_out(*p) for p in node.inputs[2:]]
+            attrs = dict(node.attrs)
+            attrs["__quantized__"] = "int8"
+            nn = _Node(node.op, node.name, attrs=attrs,
+                       inputs=new_inputs, num_outputs=node.num_outputs,
+                       user_attrs=node.user_attrs)
+            nn.uid = node.uid
+            outmap[(id(node), 0)] = (nn, 0)
+            return nn
+
+        return rebuild_graph(sym, sites, build_anchor), report

@@ -440,16 +440,19 @@ def default_manager() -> PassManager:
     """The process-wide pipeline, in order: Pallas BN(+ReLU)→1×1-conv
     fusion (r6's pass, ported), residual-chain fusion (BN(+ReLU)→conv
     of any geometry onto the analytic-backward composite op),
-    inference-time BN constant-folding, bf16 activation-traffic
-    widening."""
+    inference-time BN constant-folding, int8 weight PTQ (after bn_fold
+    so it quantizes the FOLDED weights, before bf16_cast which bails on
+    quantized sites), bf16 activation-traffic widening."""
     if _default[0] is None:
         from .pallas_fusion import PallasFusionPass
         from .residual_fusion import ResidualFusionPass
         from .bn_fold import BNFoldPass
+        from .int8_ptq import Int8PTQPass
         from .bf16_cast import Bf16CastPass
         _default[0] = PassManager([PallasFusionPass(),
                                    ResidualFusionPass(),
                                    BNFoldPass(),
+                                   Int8PTQPass(),
                                    Bf16CastPass()])
     return _default[0]
 

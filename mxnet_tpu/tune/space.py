@@ -29,7 +29,8 @@ import random
 from typing import Dict, List, Optional, Sequence
 
 __all__ = ["Knob", "SearchSpace", "pass_knobs", "tile_knobs",
-           "data_knobs", "serving_knobs", "decode_knobs", "batch_knob"]
+           "data_knobs", "serving_knobs", "decode_knobs", "batch_knob",
+           "quant_knobs"]
 
 
 class Knob:
@@ -209,6 +210,26 @@ def decode_knobs(slot_counts: Sequence[int],
              doc="prefill seq-bucket set"),
         Knob("max_wait_us", tuple(int(w) for w in waits), kind="param",
              doc="DecodeBatcher first-fill window"),
+    ]
+
+
+def quant_knobs(granularities: Sequence[str] = ("per_channel",
+                                                "per_tensor"),
+                kv_dtypes: Sequence[str] = ("float32", "int8")
+                ) -> List[Knob]:
+    """Quantization posture knobs (round 19): weight-scale granularity
+    (per-channel scales track outlier channels; per-tensor ships fewer
+    scale bytes but one bad channel can blow the layer past the
+    accuracy guard and DISABLE it — measurably worse bytes, which is
+    the point of searching) × decode KV-cache storage dtype. Defaults
+    first — they are the registered env defaults, so the tuner measures
+    int8-KV as an IMPROVEMENT over the default posture rather than
+    assuming it."""
+    return [
+        Knob("MXTPU_QUANT_GRANULARITY", tuple(granularities),
+             kind="env", doc="int8 PTQ weight-scale granularity"),
+        Knob("MXTPU_DECODE_KV_DTYPE", tuple(kv_dtypes), kind="env",
+             doc="decode KV-cache storage dtype"),
     ]
 
 

@@ -36,12 +36,12 @@ from __future__ import annotations
 from typing import Dict, Optional, Sequence
 
 from .space import SearchSpace, Knob, pass_knobs, batch_knob, \
-    serving_knobs, data_knobs, decode_knobs
+    serving_knobs, data_knobs, decode_knobs, quant_knobs
 
 __all__ = ["Workload", "TrainStepWorkload", "ServingWorkload",
            "DecodeServingWorkload", "DataPipelineWorkload",
-           "conv_proxy", "sparse_proxy", "decode_proxy",
-           "builtin_workload", "measure_serving",
+           "QuantWorkload", "conv_proxy", "sparse_proxy", "decode_proxy",
+           "quant_proxy", "builtin_workload", "measure_serving",
            "measure_decode_serving", "BUILTIN_WORKLOADS"]
 
 
@@ -380,6 +380,94 @@ class DecodeServingWorkload(Workload):
 
 
 # ---------------------------------------------------------------------------
+# quantization posture: total-bytes objective over granularity × KV dtype
+# ---------------------------------------------------------------------------
+class QuantWorkload(Workload):
+    """Round-19 quantization-posture search: weight-scale granularity ×
+    decode KV-cache dtype (both env knobs — the runner applies them via
+    ``config.override``, this workload only reads the ambient values).
+    The objective is one bytes total in the r12 gate currency: the
+    int8-PTQ-rewritten serving program's cost-analysis bytes
+    (calibrated at the trial's granularity — a layer the accuracy guard
+    disables stays fp32, so a granularity that trips the guard measures
+    WORSE, never silently wrong) + the decode-step bytes + the KV-cache
+    footprint of an engine built at the trial's KV dtype. A "win" here
+    is the same measured claim the pass manager's gate enforces."""
+
+    objective = "quant_bytes_total"
+
+    def __init__(self, name, symbol, params, feed_shapes: Dict[str, tuple],
+                 make_engine, space: Optional[SearchSpace] = None,
+                 data_names: Optional[Sequence[str]] = None):
+        space = space or SearchSpace(quant_knobs(), name=f"{name}-quant")
+        super().__init__(space)
+        self.name = name
+        self.symbol = symbol
+        self.params = dict(params)
+        self.feed_shapes = {n: tuple(s) for n, s in feed_shapes.items()}
+        self.make_engine = make_engine
+        self.data_names = set(data_names or self.feed_shapes)
+        self._engines = {}     # kv_dtype -> warmed engine (compile half)
+
+    def key_material(self):
+        from ..compile.key import symbol_digest
+        m = super().key_material()
+        m["symbol_sha"] = symbol_digest(self.symbol)
+        m["input_sigs"] = sorted(self.feed_shapes.items())
+        return m
+
+    def _shapes(self) -> Dict[str, tuple]:
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(
+            **self.feed_shapes)
+        shapes = dict(zip(self.symbol.list_arguments(), arg_shapes))
+        shapes.update(zip(self.symbol.list_auxiliary_states(),
+                          aux_shapes))
+        return shapes
+
+    def _engine(self, kv_dtype):
+        if kv_dtype not in self._engines:
+            eng = self.make_engine(kv_dtype)
+            eng.warmup()
+            self._engines[kv_dtype] = eng
+        return self._engines[kv_dtype]
+
+    def measure(self, cfg, budget):
+        from .. import config as _config
+        from .. import quant as _q
+        from ..base import MXNetError
+        from ..symbol import passes as P
+        gran = str(_config.get("MXTPU_QUANT_GRANULARITY", "per_channel"))
+        kvd = str(_config.get("MXTPU_DECODE_KV_DTYPE", "float32"))
+        qcfg = _q.calibrate((self.symbol, self.params), granularity=gran)
+        shapes = self._shapes()
+        # force the pass on: the trial IS the measurement, so the gate's
+        # auto-posture double-measure is redundant work here (forced
+        # flags are trusted under MXTPU_PASS_GATE_BYTES=auto)
+        with _q.quant_scope(qcfg), \
+                _config.override("MXTPU_PASS_INT8_PTQ", "1"):
+            final, _rep = P.apply_pipeline(
+                self.symbol, shapes, tag="tune", mode="serving",
+                data_names=self.data_names)
+            sym2 = final if final is not None else self.symbol
+            serving = P.measure_symbol_bytes(
+                sym2, shapes, mode="serving", data_names=self.data_names)
+        if serving is None:
+            raise MXNetError(
+                f"{self.name}: backend exposes no cost analysis — the "
+                "bytes objective cannot be measured")
+        eng = self._engine(kvd)
+        decode = float(eng.program_cost("decode").get(
+            "bytes accessed", 0.0))
+        kv = float(eng.kv_cache_bytes())
+        return {"objective": float(serving) + decode + kv,
+                "serving_bytes": float(serving),
+                "decode_step_bytes": decode,
+                "kv_cache_bytes": kv,
+                "granularity": gran, "kv_dtype": kvd,
+                "quant_layers_enabled": len(qcfg.enabled_layers())}
+
+
+# ---------------------------------------------------------------------------
 # data pipeline: drain-wall objective over worker/staging knobs
 # ---------------------------------------------------------------------------
 class DataPipelineWorkload(Workload):
@@ -534,8 +622,40 @@ def decode_proxy(slot_counts=(2, 4), bucket_sets=("16", "16,32"),
     return wl
 
 
+def quant_proxy(batch: int = 4, slots: int = 2,
+                seq_buckets=(8,)) -> QuantWorkload:
+    """The quant-family built-in: granularity × KV-dtype knobs over the
+    conv proxy (deterministic seed-0 weights — the FC "fc" layer
+    exercises the dense-off bailout on CPU backends) plus a pocket
+    decode engine, total-bytes objective."""
+    import numpy as np
+    from ..serving.decode import TransformerLMSpec, DecodePredictor, \
+        init_params
+    sym = _conv_symbol()
+    feed = {"data": (batch, 8, 8, 8), "softmax_label": (batch,)}
+    arg_shapes, _, aux_shapes = sym.infer_shape(**feed)
+    rng = np.random.RandomState(0)
+    params = {}
+    for n, s in list(zip(sym.list_arguments(), arg_shapes)) + \
+            list(zip(sym.list_auxiliary_states(), aux_shapes)):
+        if n not in feed:
+            params[n] = rng.uniform(-0.5, 0.5, size=s).astype(np.float32)
+    spec = TransformerLMSpec(vocab_size=64, num_embed=32, num_heads=2,
+                             num_layers=2, max_seq=16, name="quantlm")
+    lm_params = init_params(spec, seed=0)
+
+    def make_engine(kv_dtype):
+        return DecodePredictor(spec, lm_params, slots=slots,
+                               seq_buckets=tuple(seq_buckets),
+                               kv_dtype=kv_dtype)
+
+    wl = QuantWorkload("quant_posture", sym, params, feed, make_engine)
+    wl.builtin = "quant"
+    return wl
+
+
 BUILTIN_WORKLOADS = {"conv": conv_proxy, "sparse": sparse_proxy,
-                     "decode": decode_proxy}
+                     "decode": decode_proxy, "quant": quant_proxy}
 
 
 def builtin_workload(name: str, **kwargs) -> Workload:

@@ -360,3 +360,24 @@ def test_mesh_training_suite_stays_tier1():
     assert "test_mesh_training.py" not in uses.get("slow", set()), (
         "test_mesh_training.py cases must not be slow-marked — the "
         "mesh-native training pins are round-18 acceptance criteria")
+
+
+def test_quant_suite_stays_tier1():
+    """The quantization suite is tier-1's only proof that the
+    ``int8_ptq`` rewrite is bit-exact against the numpy oracle, that
+    the quantized serving program moves strictly fewer bytes, and that
+    the int8 KV-cache keeps batched decode bit-identical to solo (the
+    round-19 tentpole). It must exist and never carry a ``slow`` mark —
+    the nets are toy-sized and the whole file runs in seconds."""
+    path = os.path.join(_TESTS, "test_quant.py")
+    assert os.path.exists(path), "tests/test_quant.py missing"
+    with open(path) as f:
+        src = f.read()
+    m = re.search(r"^pytestmark\s*=.*$", src, re.M)
+    assert m is None or "slow" not in m.group(0), (
+        "test_quant.py must stay tier-1: a module-level slow mark "
+        "drops the PTQ bit-exactness and bytes-gate pins from the gate")
+    uses = _mark_uses()
+    assert "test_quant.py" not in uses.get("slow", set()), (
+        "test_quant.py cases must not be slow-marked — the "
+        "quantization pins are round-19 acceptance criteria")

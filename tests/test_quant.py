@@ -1,0 +1,579 @@
+"""The quantization subsystem (mxnet_tpu/quant/ + the int8_ptq pass +
+the int8 decode KV-cache), round 19:
+
+- the numpy observer oracle: ``compute_scales`` / ``quantize_np`` pin
+  the scale math and the half-away-from-zero rounding the in-graph
+  rewrite must match bit-for-bit;
+- calibration is deterministic (same module + iterator -> byte-identical
+  JSON) and the per-layer accuracy guard DISABLES layers instead of
+  shipping them wrong;
+- the ``int8_ptq`` pass: skip-counted without an ambient config,
+  bit-exact against the numpy-simulated quantization of the enabled
+  layers, STRICTLY fewer serving bytes than the same pipeline without
+  it, and the dense gate (``MXTPU_QUANT_DENSE=auto``) bails FC sites on
+  CPU where the dot emitter un-fuses the dequantize;
+- composition with hoisting: a quantized Predictor's hoisted program
+  arguments include the int8 weights (the ``__no_hoist__`` barrier
+  keeps the f32 expansion inside the program);
+- pass-ordering hardening: bf16-first refuses to double-cast,
+  bn_fold-after-quant refuses to requantize, and the intended
+  bn_fold -> int8_ptq order quantizes the FOLDED weight (config lookup
+  strips the ``__bnfold`` rename);
+- the int8 KV-cache: <= 0.55x the f32 cache bytes, strictly fewer
+  decode-step bytes, a DIFFERENT compile key (cache layout is key
+  material), greedy tokens matching f32, and batched decode
+  bit-identical to solo under int8;
+- the ``quant`` tune workload: granularity + KV-dtype knobs, and the
+  int8-KV config measures a strictly lower objective than the default;
+- tools/quant.py calibrate/show/verify round-trip, verify exiting 2
+  when the accuracy tolerance is impossible.
+"""
+import contextlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import quant as Q
+from mxnet_tpu.quant.observers import (QMAX, SCALE_FLOOR, compute_scales,
+                                       dequantize_np, quantize_np)
+from mxnet_tpu.symbol import passes as P
+
+_TESTS = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_TESTS)
+
+_DN = {"data", "softmax_label"}
+
+
+@contextlib.contextmanager
+def _pass_flags(**flags):
+    """Force the quantization-relevant pass flags; unlisted ones get
+    "0" so the assertions only see the passes under test."""
+    want = {"MXTPU_PASS_INT8_PTQ": "0", "MXTPU_PASS_BN_FOLD": "0",
+            "MXTPU_PASS_BF16": "0", "MXTPU_PASS_RESIDUAL_FUSION": "0",
+            "MXTPU_PALLAS_FUSION": "0"}
+    want.update(flags)
+    with contextlib.ExitStack() as stack:
+        for name, value in want.items():
+            stack.enter_context(mx.config.override(name, value))
+        yield
+
+
+def _convnet():
+    data = mx.sym.Variable("data")
+    x = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=8,
+                           no_bias=True, name="qc1")
+    x = mx.sym.Activation(x, act_type="relu", name="qr1")
+    x = mx.sym.Convolution(x, kernel=(3, 3), pad=(1, 1), num_filter=8,
+                           no_bias=True, name="qc2")
+    x = mx.sym.Pooling(x, global_pool=True, kernel=(1, 1),
+                       pool_type="avg", name="qp")
+    x = mx.sym.FullyConnected(mx.sym.Flatten(x), num_hidden=10,
+                              name="qfc")
+    return mx.sym.SoftmaxOutput(x, name="softmax")
+
+
+def _bn_convnet():
+    data = mx.sym.Variable("data")
+    x = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=8,
+                           no_bias=True, name="ac1")
+    x = mx.sym.BatchNorm(x, name="abn1", fix_gamma=False)
+    x = mx.sym.Activation(x, act_type="relu", name="ar1")
+    x = mx.sym.Pooling(x, global_pool=True, kernel=(1, 1),
+                       pool_type="avg", name="ap")
+    x = mx.sym.FullyConnected(mx.sym.Flatten(x), num_hidden=10,
+                              name="afc")
+    return mx.sym.SoftmaxOutput(x, name="softmax")
+
+
+def _shapes_params(sym, batch=4, chan=4, seed=0):
+    arg_shapes, _, aux_shapes = sym.infer_shape(
+        data=(batch, chan, 8, 8), softmax_label=(batch,))
+    shapes = dict(zip(sym.list_arguments(), arg_shapes))
+    shapes.update(zip(sym.list_auxiliary_states(), aux_shapes))
+    rng = np.random.RandomState(seed)
+    params = {}
+    for n, s in shapes.items():
+        if n in _DN:
+            continue
+        if "var" in n or "gamma" in n:
+            # BN stats/scales must be positive or rsqrt goes NaN
+            params[n] = rng.uniform(0.5, 1.0, s).astype(np.float32)
+        else:
+            params[n] = rng.uniform(-0.5, 0.5, s).astype(np.float32)
+    return shapes, params
+
+
+def _ptq_entry(report):
+    return next(e for e in report["passes"] if e["pass"] == "int8_ptq")
+
+
+# ---------------------------------------------------------------------
+# observers: the numpy oracle itself
+
+def test_compute_scales_per_channel_and_per_tensor():
+    rng = np.random.RandomState(3)
+    w = rng.uniform(-2.0, 2.0, (8, 4, 3, 3)).astype(np.float32)
+    sc = compute_scales(w, per_channel=True)
+    assert sc.shape == (8, 1, 1, 1)
+    want = np.max(np.abs(w), axis=(1, 2, 3), keepdims=True) / QMAX
+    assert np.allclose(sc, np.maximum(want, SCALE_FLOOR))
+    st = compute_scales(w, per_channel=False)
+    assert st.shape == (1, 1, 1, 1)
+    assert np.allclose(st, max(float(np.max(np.abs(w))) / QMAX,
+                               SCALE_FLOOR))
+    # clip_fraction shrinks the scale proportionally
+    sc2 = compute_scales(w, per_channel=True, clip_fraction=0.5)
+    assert np.allclose(sc2, np.maximum(want * 0.5, SCALE_FLOOR))
+
+
+def test_quantize_np_half_away_from_zero():
+    # the symbol `round` op rounds half away from zero; numpy's
+    # np.round would give [0, 2, 2, -0, -2] and diverge from the graph
+    scale = np.float32(1.0)
+    w = np.array([0.5, 1.5, 2.5, -0.5, -1.5], np.float32)
+    assert quantize_np(w, scale).tolist() == [1, 2, 3, -1, -2]
+    # saturation clips at +/-127
+    assert quantize_np(np.array([1e6, -1e6], np.float32),
+                       scale).tolist() == [127, -127]
+    # all-zero channel: the scale floor keeps dequant finite
+    z = np.zeros((2, 3), np.float32)
+    sz = compute_scales(z, per_channel=True)
+    assert np.all(sz == SCALE_FLOOR)
+    assert np.all(np.isfinite(dequantize_np(quantize_np(z, sz), sz)))
+
+
+# ---------------------------------------------------------------------
+# calibration
+
+def test_calibration_deterministic():
+    sym = _convnet()
+    _, params = _shapes_params(sym)
+    rng = np.random.RandomState(1)
+    batches = [{"data": rng.rand(4, 4, 8, 8).astype(np.float32),
+                "softmax_label": np.zeros((4,), np.float32)}
+               for _ in range(3)]
+    a = Q.calibrate((sym, params), data_iter=batches)
+    b = Q.calibrate((sym, params), data_iter=batches)
+    assert a.to_json() == b.to_json()
+    assert a.model_error is not None
+    assert set(a.layers) == {"qc1", "qc2", "qfc"}
+
+
+def test_calibration_scales_match_oracle():
+    sym = _convnet()
+    _, params = _shapes_params(sym)
+    cfg = Q.calibrate((sym, params), observer="absmax",
+                      granularity="per_channel")
+    for name in ("qc1", "qc2"):
+        e = cfg.layers[name]
+        assert e["enabled"], e
+        want = compute_scales(params[e["weight"]], per_channel=True,
+                              clip_fraction=e["clip_fraction"])
+        assert np.allclose(np.asarray(e["scales"], np.float32),
+                           want.reshape(-1))
+    # per-tensor: one scale per layer
+    ct = Q.calibrate((sym, params), observer="absmax",
+                     granularity="per_tensor")
+    assert all(len(e["scales"]) == 1 for e in ct.layers.values())
+
+
+def test_calibration_accuracy_guard_disables_layers():
+    sym = _convnet()
+    _, params = _shapes_params(sym)
+    cfg = Q.calibrate((sym, params), observer="absmax", tolerance=0.0)
+    assert cfg.enabled_layers() == []
+    assert all("tolerance" in e["reason"] for e in cfg.layers.values())
+    # and the pass bails on them LOUDLY instead of quantizing anyway
+    shapes, _ = _shapes_params(sym)
+    with Q.quant_scope(cfg), _pass_flags(MXTPU_PASS_INT8_PTQ="1"):
+        final, rep = P.apply_pipeline(sym, shapes, tag="quant-guard",
+                                      mode="serving", data_names=_DN)
+    entry = _ptq_entry(rep)
+    assert entry["sites"] == []
+    disabled = [b for b in entry["bailouts"]
+                if "disabled by calibration" in b["reason"]]
+    assert {b["site"] for b in disabled} == {"qc1", "qc2", "qfc"}
+
+
+def test_calibration_rejects_unknown_granularity_and_module():
+    sym = _convnet()
+    _, params = _shapes_params(sym)
+    with pytest.raises(ValueError):
+        Q.calibrate((sym, params), granularity="per_banana")
+    with pytest.raises(TypeError):
+        Q.calibrate(object())
+
+
+def test_quant_config_roundtrip(tmp_path):
+    sym = _convnet()
+    _, params = _shapes_params(sym)
+    cfg = Q.calibrate((sym, params))
+    path = str(tmp_path / "qconfig.json")
+    cfg.save(path)
+    back = Q.QuantConfig.load(path)
+    assert back.to_json() == cfg.to_json()
+    # lookup strips the bn_fold rename so the config survives folding
+    assert back.lookup("qc1__bnfold") is back.layers["qc1"]
+
+
+# ---------------------------------------------------------------------
+# the int8_ptq pass
+
+def test_pass_skips_without_config():
+    sym = _convnet()
+    shapes, _ = _shapes_params(sym)
+    assert Q.current_config() is None
+    with _pass_flags(MXTPU_PASS_INT8_PTQ="1"):
+        _, rep = P.apply_pipeline(sym, shapes, tag="quant-nocfg",
+                                  mode="serving", data_names=_DN)
+    entry = _ptq_entry(rep)
+    assert entry["status"] == "skipped"
+    assert entry["reason"] == "no_quant_config"
+
+
+def test_pass_output_matches_numpy_oracle():
+    """The rewritten graph == numpy-simulated quantization of exactly
+    the layers the pass rewrote, bit-for-bit."""
+    sym = _convnet()
+    shapes, params = _shapes_params(sym)
+    cfg = Q.calibrate((sym, params), observer="absmax")
+    with Q.quant_scope(cfg), _pass_flags(MXTPU_PASS_INT8_PTQ="1"):
+        final, rep = P.apply_pipeline(sym, shapes, tag="quant-oracle",
+                                      mode="serving", data_names=_DN)
+    assert final is not None
+    entry = _ptq_entry(rep)
+    qnames = {s["site"] for s in entry["sites"]}
+    assert qnames == {"qc1", "qc2"}     # fc gated off on CPU
+
+    rng = np.random.RandomState(7)
+    amap = dict(params)
+    amap["data"] = rng.rand(4, 4, 8, 8).astype(np.float32)
+    amap["softmax_label"] = np.zeros((4,), np.float32)
+    outs_q, _ = final.eval_arrays_ex(dict(amap), training=False)
+
+    amap_o = dict(amap)
+    for lname in qnames:
+        e = cfg.layers[lname]
+        w = params[e["weight"]]
+        sc = compute_scales(w, per_channel=True,
+                            clip_fraction=e["clip_fraction"])
+        amap_o[e["weight"]] = dequantize_np(quantize_np(w, sc), sc)
+    outs_o, _ = sym.eval_arrays_ex(amap_o, training=False)
+    np.testing.assert_array_equal(np.asarray(outs_q[0]),
+                                  np.asarray(outs_o[0]))
+
+
+def test_measured_gate_serving_bytes_strictly_below():
+    """The r12 gate currency: the quantized serving program moves
+    STRICTLY fewer cost-analysis bytes than the same pipeline without
+    int8_ptq, at every bucket."""
+    sym = _convnet()
+    cfg = None
+    for batch in (2, 4):
+        shapes, params = _shapes_params(sym, batch=batch)
+        if cfg is None:
+            cfg = Q.calibrate((sym, params), observer="absmax")
+        with Q.quant_scope(cfg):
+            with _pass_flags(MXTPU_PASS_INT8_PTQ="1"):
+                f1, _ = P.apply_pipeline(
+                    sym, shapes, tag=f"quant-gate-q{batch}",
+                    mode="serving", data_names=_DN)
+                q_bytes = P.measure_symbol_bytes(
+                    f1 if f1 is not None else sym, shapes,
+                    mode="serving", data_names=_DN)
+            with _pass_flags(MXTPU_PASS_INT8_PTQ="0"):
+                f0, _ = P.apply_pipeline(
+                    sym, shapes, tag=f"quant-gate-b{batch}",
+                    mode="serving", data_names=_DN)
+                base_bytes = P.measure_symbol_bytes(
+                    f0 if f0 is not None else sym, shapes,
+                    mode="serving", data_names=_DN)
+        if q_bytes is None or base_bytes is None:
+            pytest.skip("cost analysis unavailable on this backend")
+        assert q_bytes < base_bytes, \
+            f"bucket {batch}: {q_bytes} !< {base_bytes}"
+
+
+def test_dense_gate_off_on_cpu_on_when_forced():
+    sym = _convnet()
+    shapes, params = _shapes_params(sym)
+    cfg = Q.calibrate((sym, params), observer="absmax")
+    with Q.quant_scope(cfg), _pass_flags(MXTPU_PASS_INT8_PTQ="1"):
+        _, rep = P.apply_pipeline(sym, shapes, tag="quant-dense-auto",
+                                  mode="serving", data_names=_DN)
+        entry = _ptq_entry(rep)
+        fc_bail = [b for b in entry["bailouts"] if b["site"] == "qfc"]
+        assert fc_bail and "MXTPU_QUANT_DENSE" in fc_bail[0]["reason"]
+        # forcing the flag proposes the fc site (the measured bytes
+        # gate stays the arbiter of whether the rewrite ships)
+        with mx.config.override("MXTPU_QUANT_DENSE", "1"):
+            _, rep2 = P.apply_pipeline(sym, shapes,
+                                       tag="quant-dense-forced",
+                                       mode="serving", data_names=_DN)
+        sites2 = {s["site"] for s in _ptq_entry(rep2)["sites"]}
+        bails2 = {b["site"] for b in _ptq_entry(rep2)["bailouts"]}
+        assert "qfc" in sites2 | bails2
+        assert not any(b["site"] == "qfc" and
+                       "MXTPU_QUANT_DENSE" in b["reason"]
+                       for b in _ptq_entry(rep2)["bailouts"])
+
+
+def test_predictor_hoists_int8_weights():
+    """Composition with hoisting: the staged Predictor's precomputed
+    program arguments are the int8 weights + their f32 scales — the
+    ``__no_hoist__`` barrier keeps the dequantize inside the program."""
+    sym = _convnet()
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("softmax_label",), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 4, 8, 8))],
+             label_shapes=[("softmax_label", (4,))], for_training=False)
+    mod.init_params(mx.init.Xavier())
+    cfg = Q.calibrate(mod, observer="absmax")
+    with Q.quant_scope(cfg), _pass_flags(MXTPU_PASS_INT8_PTQ="1"):
+        pred = mod.as_predictor(buckets=(4,))
+        pred.warmup()
+    dtypes = sorted(str(v.dtype) for v in pred._hvals)
+    assert dtypes == ["float32", "float32", "int8", "int8"]
+    entry = _ptq_entry(pred.pass_report)
+    assert {s["site"] for s in entry["sites"]} == {"qc1", "qc2"}
+    # and the quantized program still predicts: same argmax class as
+    # the f32 graph on the same batch
+    rng = np.random.RandomState(11)
+    x = rng.rand(4, 4, 8, 8).astype(np.float32)
+    q_out = np.asarray(pred.predict(x))
+    arg_params, aux_params = mod.get_params()
+    amap = {n: v.asnumpy() for n, v in arg_params.items()}
+    amap.update({n: v.asnumpy() for n, v in aux_params.items()})
+    amap["data"] = x
+    amap["softmax_label"] = np.zeros((4,), np.float32)
+    f_out = np.asarray(sym.eval_arrays_ex(amap, training=False)[0][0])
+    assert q_out.shape == f_out.shape == (4, 10)
+    assert np.array_equal(np.argmax(q_out, axis=1),
+                          np.argmax(f_out, axis=1))
+
+
+# ---------------------------------------------------------------------
+# pass-ordering hardening (the r19 adversarial pins)
+
+def test_bf16_first_refuses_double_cast():
+    sym = _bn_convnet()
+    shapes, params = _shapes_params(sym)
+    cfg = Q.calibrate((sym, params), observer="absmax")
+    with _pass_flags(MXTPU_PASS_BF16="1"):
+        s_bf16, _ = P.apply_pipeline(sym, shapes, tag="adv-bf16-first",
+                                     mode="serving", data_names=_DN)
+    assert s_bf16 is not None
+    with Q.quant_scope(cfg), _pass_flags(MXTPU_PASS_INT8_PTQ="1"):
+        _, rep = P.apply_pipeline(s_bf16, shapes,
+                                  tag="adv-int8-after-bf16",
+                                  mode="serving", data_names=_DN)
+    entry = _ptq_entry(rep)
+    assert entry["sites"] == []
+    reasons = [b["reason"] for b in entry["bailouts"]
+               if b["site"] == "ac1"]
+    assert reasons and "refusing to double-cast" in reasons[0]
+
+
+def test_bn_fold_refuses_quantized_conv():
+    sym = _bn_convnet()
+    shapes, params = _shapes_params(sym)
+    cfg = Q.calibrate((sym, params), observer="absmax")
+    with Q.quant_scope(cfg), _pass_flags(MXTPU_PASS_INT8_PTQ="1"):
+        s_q, rep_q = P.apply_pipeline(sym, shapes, tag="adv-int8-first",
+                                      mode="serving", data_names=_DN)
+    assert {s["site"] for s in _ptq_entry(rep_q)["sites"]} == {"ac1"}
+    with _pass_flags(MXTPU_PASS_BN_FOLD="1"):
+        _, rep = P.apply_pipeline(s_q, shapes,
+                                  tag="adv-bnfold-after-int8",
+                                  mode="serving", data_names=_DN)
+    bn = next(e for e in rep["passes"] if e["pass"] == "bn_fold")
+    reasons = [b["reason"] for b in bn["bailouts"]]
+    assert any("int8-quantized" in r for r in reasons)
+
+
+def test_composed_order_quantizes_folded_weight():
+    """bn_fold then int8_ptq (the pipeline order): the quantized site
+    is the FOLDED conv — the config lookup strips ``__bnfold``."""
+    sym = _bn_convnet()
+    shapes, params = _shapes_params(sym)
+    cfg = Q.calibrate((sym, params), observer="absmax")
+    with Q.quant_scope(cfg), \
+            _pass_flags(MXTPU_PASS_INT8_PTQ="1", MXTPU_PASS_BN_FOLD="1"):
+        final, rep = P.apply_pipeline(sym, shapes, tag="adv-composed",
+                                      mode="serving", data_names=_DN)
+    assert final is not None
+    assert {s["site"] for s in _ptq_entry(rep)["sites"]} == \
+        {"ac1__bnfold"}
+
+
+# ---------------------------------------------------------------------
+# the int8 decode KV-cache
+
+@pytest.fixture(scope="module")
+def lm_engines():
+    from mxnet_tpu.serving.decode import (DecodePredictor,
+                                          TransformerLMSpec, init_params)
+    spec = TransformerLMSpec(vocab_size=32, num_embed=16, num_heads=2,
+                             num_layers=1, max_seq=16, name="tqlm")
+    params = init_params(spec, seed=0)
+    f32 = DecodePredictor(spec, params, slots=2, seq_buckets=(8,),
+                          name="tqlm-f32", kv_dtype="float32")
+    i8 = DecodePredictor(spec, params, slots=2, seq_buckets=(8,),
+                         name="tqlm-i8", kv_dtype="int8")
+    f32.warmup()
+    i8.warmup()
+    return spec, params, f32, i8
+
+
+def test_int8_kv_cache_bytes_ratio(lm_engines):
+    _, _, f32, i8 = lm_engines
+    assert i8.kv_cache_bytes() <= 0.55 * f32.kv_cache_bytes()
+    assert i8.report()["kv_dtype"] == "int8"
+
+
+def test_int8_kv_decode_step_bytes_below_f32(lm_engines):
+    _, _, f32, i8 = lm_engines
+    bf = f32.program_cost("decode").get("bytes accessed")
+    bq = i8.program_cost("decode").get("bytes accessed")
+    if not bf or not bq:
+        pytest.skip("cost analysis unavailable on this backend")
+    assert bq < bf
+
+
+def test_kv_dtype_is_compile_key_material(lm_engines):
+    """Same spec/params/name, different KV dtype -> different decode
+    program key (the cache layout is key material, so a persistent
+    cache can never replay an f32 program against int8 buffers)."""
+    from mxnet_tpu.serving.decode import DecodePredictor
+    spec, params, _, _ = lm_engines
+    a = DecodePredictor(spec, params, slots=2, seq_buckets=(8,),
+                        name="tqlm-key", kv_dtype="float32")
+    b = DecodePredictor(spec, params, slots=2, seq_buckets=(8,),
+                        name="tqlm-key", kv_dtype="int8")
+    assert a._program_key("decode") != b._program_key("decode")
+    assert a._program_key("prefill", 8) != b._program_key("prefill", 8)
+
+
+def test_int8_kv_greedy_tokens_match_f32(lm_engines):
+    _, _, f32, i8 = lm_engines
+    prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+    for p in prompts:
+        assert list(f32.generate(p, max_new_tokens=8)) == \
+            list(i8.generate(p, max_new_tokens=8))
+
+
+def test_int8_batched_decode_equals_solo(lm_engines):
+    """Continuous batching stays bit-identical to the solo surface
+    under the quantized cache — quantization happens per row at write
+    time, so co-residents cannot perturb each other."""
+    _, _, _, i8 = lm_engines
+    prompts = [[1, 2, 3], [4, 5]]
+    solo = [list(i8.generate(p, max_new_tokens=8)) for p in prompts]
+    slots = [i8.alloc_slot() for _ in prompts]
+    cur = {s: i8.prefill(s, p) for s, p in zip(slots, prompts)}
+    streams = {s: [cur[s]] for s in slots}
+    for _ in range(7):
+        cur = i8.decode(cur)
+        for s, t in cur.items():
+            streams[s].append(t)
+    for s in slots:
+        i8.release(s)
+    assert [streams[s] for s in slots] == solo
+
+
+def test_kv_dtype_env_default(lm_engines):
+    from mxnet_tpu.serving.decode import DecodePredictor
+    spec, params, _, _ = lm_engines
+    with mx.config.override("MXTPU_DECODE_KV_DTYPE", "int8"):
+        eng = DecodePredictor(spec, params, slots=2, seq_buckets=(8,),
+                              name="tqlm-env")
+    assert eng.kv_dtype == "int8"
+    with pytest.raises(Exception):
+        DecodePredictor(spec, params, slots=2, seq_buckets=(8,),
+                        name="tqlm-bad", kv_dtype="int4")
+
+
+# ---------------------------------------------------------------------
+# the quant tune workload
+
+def test_quant_workload_knobs_and_objective():
+    from mxnet_tpu.tune.workloads import quant_proxy
+    wl = quant_proxy()
+    knobs = {k.name for k in wl.space.knobs}
+    assert knobs == {"MXTPU_QUANT_GRANULARITY", "MXTPU_DECODE_KV_DTYPE"}
+    assert wl.objective == "quant_bytes_total"
+    assert wl.builtin == "quant"
+
+    def measured(cfg):
+        with contextlib.ExitStack() as stack:
+            for name, value in wl.space.env_items(cfg):
+                stack.enter_context(mx.config.override(name, value))
+            return wl.measure(cfg, budget=1)
+
+    default = measured(wl.space.default_config())
+    int8 = measured({"MXTPU_QUANT_GRANULARITY": "per_channel",
+                     "MXTPU_DECODE_KV_DTYPE": "int8"})
+    assert default["kv_dtype"] == "float32"
+    assert int8["kv_dtype"] == "int8"
+    assert int8["kv_cache_bytes"] < default["kv_cache_bytes"]
+    # the int8 KV config must measure STRICTLY better, or the tuner
+    # could never find the quantized deployment
+    assert int8["objective"] < default["objective"]
+    assert default["quant_layers_enabled"] > 0
+
+
+# ---------------------------------------------------------------------
+# tools/quant.py CLI
+
+def _save_artifacts(tmp_path):
+    sym = _convnet()
+    _, params = _shapes_params(sym)
+    sym_path = str(tmp_path / "net.json")
+    params_path = str(tmp_path / "net.npz")
+    sym.save(sym_path)
+    np.savez(params_path, **params)
+    return sym_path, params_path
+
+
+def _cli(*argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "quant.py"),
+         *argv], capture_output=True, text=True, env=env, cwd=_ROOT)
+
+
+def test_cli_calibrate_show_verify(tmp_path):
+    sym_path, params_path = _save_artifacts(tmp_path)
+    cfg_path = str(tmp_path / "qconfig.json")
+    r = _cli("calibrate", sym_path, params_path, "--out", cfg_path,
+             "--observer", "absmax", "--shape", "data=4,4,8,8",
+             "--shape", "softmax_label=4", "--batches", "2")
+    assert r.returncode == 0, r.stderr
+    assert "calibrated 3 layer(s)" in r.stdout
+    assert "model_error" in r.stdout
+
+    r = _cli("show", cfg_path)
+    assert r.returncode == 0, r.stderr
+    for name in ("qc1", "qc2", "qfc"):
+        assert name in r.stdout
+
+    r = _cli("verify", sym_path, params_path, "--config", cfg_path,
+             "--shape", "data=4,4,8,8", "--shape", "softmax_label=4",
+             "--json")
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert out["quantized_sites"] == 2
+    assert out["quantized_bytes"] < out["baseline_bytes"]
+    assert out["output_error"] <= out["tolerance"]
+
+    # an impossible tolerance must trip the accuracy gate (exit 2)
+    r = _cli("verify", sym_path, params_path, "--config", cfg_path,
+             "--shape", "data=4,4,8,8", "--shape", "softmax_label=4",
+             "--tolerance", "0")
+    assert r.returncode == 2
+    assert "accuracy tolerance" in r.stderr

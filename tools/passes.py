@@ -49,6 +49,7 @@ _FLAGS = {
     "pallas_fusion": "MXTPU_PALLAS_FUSION",
     "residual_fusion": "MXTPU_PASS_RESIDUAL_FUSION",
     "bn_fold": "MXTPU_PASS_BN_FOLD",
+    "int8_ptq": "MXTPU_PASS_INT8_PTQ",
     "bf16_cast": "MXTPU_PASS_BF16",
 }
 

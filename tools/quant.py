@@ -1,0 +1,281 @@
+#!/usr/bin/env python
+"""Calibrate, inspect, and verify int8 PTQ configs outside a process.
+
+The quantization subsystem (mxnet_tpu/quant/ + the ``int8_ptq`` pass)
+is driven by a calibration artifact — a ``QuantConfig`` JSON mapping
+layer names to per-channel scales, clip fractions, and enable/disable
+decisions. This CLI makes that artifact a first-class file you can cut
+once, diff in review, and gate in CI:
+
+    quant.py calibrate SYMBOL.json PARAMS.npz --out qconfig.json
+             [--shape data=8,3,32,32 ...] [--observer percentile|absmax]
+             [--granularity per_channel|per_tensor] [--percentile 99.9]
+             [--tolerance 0.02] [--batches 4]
+
+``calibrate`` loads a saved symbol + an ``.npz`` of trained weights,
+runs the observers, and writes the config. With ``--shape`` it also
+feeds seeded synthetic batches through the graph to record the
+end-to-end ``model_error`` (f32 vs simulated-quant outputs).
+
+    quant.py show qconfig.json [--json]
+
+``show`` prints one line per calibrated layer — enabled/disabled, the
+weight-space error vs the tolerance that decided it, clip fraction,
+and the scale range — plus the model-level error when recorded.
+
+    quant.py verify SYMBOL.json PARAMS.npz --config qconfig.json
+             --shape data=8,3,32,32 [--mode serving] [--data-names ...]
+             [--tolerance T] [--json]
+
+``verify`` is the CI gate: it replays the pass pipeline under the
+config (``MXTPU_PASS_INT8_PTQ`` forced on), then exits 2 unless BOTH
+measured claims hold — the quantized program moves STRICTLY fewer
+cost-analysis bytes than the unquantized pipeline output (the r12 gate
+currency), and the quantized outputs stay within the accuracy
+tolerance of f32 on seeded batches. The companion to
+``tools/passes.py dump --assert-bytes``, specialized to the artifact.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+
+def _parse_shape(spec):
+    name, _, dims = spec.partition("=")
+    if not dims:
+        sys.exit(f"bad --shape {spec!r}: want name=d0,d1,...")
+    try:
+        return name, tuple(int(d) for d in dims.split(","))
+    except ValueError:
+        sys.exit(f"bad --shape {spec!r}: non-integer dim")
+
+
+def _load_symbol_params(sym_path, params_path):
+    import numpy as np
+    import mxnet_tpu as mx
+    sym = mx.sym.load(sym_path)
+    try:
+        blob = np.load(params_path)
+    except Exception as e:
+        sys.exit(f"cannot load params {params_path!r}: {e}")
+    params = {k: np.asarray(blob[k]) for k in blob.files}
+    return sym, params
+
+
+def _seeded_batches(sym, params, given, n):
+    """Deterministic synthetic calibration batches for the graph's
+    data inputs (the names NOT bound by the params file)."""
+    import numpy as np
+    rng = np.random.RandomState(0)
+    data_names = [a for a in sym.list_arguments() if a not in params]
+    missing = [d for d in data_names if d not in given]
+    if missing:
+        sys.exit(f"need --shape for data input(s) {missing} "
+                 "(arguments absent from the params file)")
+    out = []
+    for _ in range(n):
+        out.append({d: rng.rand(*given[d]).astype(np.float32)
+                    for d in data_names})
+    return out
+
+
+def cmd_calibrate(args):
+    from mxnet_tpu import quant as Q
+    sym, params = _load_symbol_params(args.symbol, args.params)
+    given = dict(_parse_shape(s) for s in args.shape)
+    data_iter = _seeded_batches(sym, params, given, args.batches) \
+        if given else None
+    cfg = Q.calibrate((sym, params), data_iter=data_iter,
+                      observer=args.observer,
+                      granularity=args.granularity,
+                      percentile=args.percentile,
+                      tolerance=args.tolerance)
+    cfg.save(args.out)
+    enabled = cfg.enabled_layers()
+    print(f"calibrated {len(cfg.layers)} layer(s), "
+          f"{len(enabled)} enabled -> {args.out}")
+    if cfg.model_error is not None:
+        print(f"model_error {cfg.model_error:.6f} "
+              f"(tolerance {cfg.tolerance:g})")
+    return 0
+
+
+def cmd_show(args):
+    from mxnet_tpu import quant as Q
+    cfg = Q.QuantConfig.load(args.config)
+    if args.json:
+        print(json.dumps(cfg.to_dict(), indent=1, sort_keys=True))
+        return 0
+    print(f"granularity={cfg.granularity} observer={cfg.observer} "
+          f"tolerance={cfg.tolerance:g} "
+          f"model_error={cfg.model_error if cfg.model_error is not None else 'n/a'}")
+    for name in sorted(cfg.layers):
+        e = cfg.layers[name]
+        scales = e.get("scales") or []
+        line = (f"{name:<24} {e['kind']:<4} "
+                f"{'enabled ' if e['enabled'] else 'DISABLED'} "
+                f"err={e['error']:.6f} clip={e['clip_fraction']:.4f} "
+                f"scales[{len(scales)}]")
+        if scales:
+            line += f"={min(scales):.3g}..{max(scales):.3g}"
+        if not e["enabled"] and e.get("reason"):
+            line += f"  ({e['reason']})"
+        print(line)
+    return 0
+
+
+def cmd_verify(args):
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import config as config_mod
+    from mxnet_tpu import quant as Q
+    from mxnet_tpu.symbol import passes as P
+
+    sym, params = _load_symbol_params(args.symbol, args.params)
+    cfg = Q.QuantConfig.load(args.config)
+    tol = args.tolerance if args.tolerance is not None else cfg.tolerance
+    given = dict(_parse_shape(s) for s in args.shape)
+    try:
+        arg_shapes, _, aux_shapes = sym.infer_shape(**given)
+    except Exception as e:
+        sys.exit(f"shape inference failed ({e}); pass --shape for every "
+                 "data input")
+    shapes = dict(zip(sym.list_arguments(), arg_shapes))
+    shapes.update(zip(sym.list_auxiliary_states(), aux_shapes))
+    data_names = set(args.data_names.split(",")) if args.data_names \
+        else set(given)
+
+    with Q.quant_scope(cfg), \
+            config_mod.override("MXTPU_PASS_INT8_PTQ", "1"):
+        final, report = P.apply_pipeline(
+            sym, shapes, tag=f"cli:{os.path.basename(args.symbol)}",
+            mode=args.mode, data_names=data_names)
+        # the unquantized comparison point is the SAME pipeline minus
+        # int8_ptq — verify judges quantization, not the other passes
+        with config_mod.override("MXTPU_PASS_INT8_PTQ", "0"):
+            base_final, _ = P.apply_pipeline(
+                sym, shapes, tag="cli:base", mode=args.mode,
+                data_names=data_names)
+        base_sym = base_final if base_final is not None else sym
+        q_sym = final if final is not None else sym
+        base_bytes = P.measure_symbol_bytes(
+            base_sym, shapes, mode=args.mode, data_names=data_names)
+        q_bytes = P.measure_symbol_bytes(
+            q_sym, shapes, mode=args.mode, data_names=data_names)
+
+    ptq = next((e for e in report["passes"] if e["pass"] == "int8_ptq"),
+               None)
+    sites = len(ptq["sites"]) if ptq and ptq.get("sites") else 0
+
+    # accuracy: f32 vs quantized program on seeded batches
+    rng = np.random.RandomState(0)
+    amap = {n: np.asarray(v, dtype=np.float32)
+            for n, v in params.items()}
+    for d in given:
+        if d not in amap:
+            amap[d] = rng.rand(*given[d]).astype(np.float32)
+    outs_f, _ = base_sym.eval_arrays_ex(dict(amap), training=False)
+    outs_q, _ = q_sym.eval_arrays_ex(dict(amap), training=False)
+    errs = []
+    for of, oq in zip(outs_f, outs_q):
+        of = np.asarray(of, dtype=np.float32).reshape(-1)
+        oq = np.asarray(oq, dtype=np.float32).reshape(-1)
+        errs.append(float(np.linalg.norm(oq - of) /
+                          max(float(np.linalg.norm(of)), 1e-12)))
+    err = max(errs) if errs else 0.0
+
+    out = {
+        "config": args.config, "mode": args.mode,
+        "quantized_sites": sites,
+        "baseline_bytes": base_bytes, "quantized_bytes": q_bytes,
+        "bytes_ratio": (q_bytes / base_bytes
+                        if base_bytes and q_bytes else None),
+        "output_error": err, "tolerance": tol,
+    }
+    print(json.dumps(out, indent=1, default=str) if args.json else
+          f"sites={sites} bytes {base_bytes} -> {q_bytes} "
+          f"(ratio {out['bytes_ratio']}) error {err:.6f} (tol {tol:g})")
+
+    if not sites:
+        print("VERIFY FAILED: int8_ptq quantized zero sites under this "
+              "config", file=sys.stderr)
+        return 2
+    if base_bytes is None or q_bytes is None:
+        print("VERIFY FAILED: cost analysis unavailable on this backend "
+              "— the bytes claim cannot be checked", file=sys.stderr)
+        return 2
+    if q_bytes >= base_bytes:
+        print(f"VERIFY FAILED: quantized program moves {q_bytes:.6g} "
+              f"bytes, not strictly below the unquantized "
+              f"{base_bytes:.6g}", file=sys.stderr)
+        return 2
+    if not (err <= tol):     # NaN error must FAIL the gate, not skip it
+        print(f"VERIFY FAILED: output error {err:.6f} exceeds the "
+              f"accuracy tolerance {tol:g}", file=sys.stderr)
+        return 2
+    print("quant gate OK", file=sys.stderr)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Calibrate / inspect / verify int8 PTQ configs; "
+                    "verify is the CI gate (exit 2 on regression)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("calibrate", help="run observers over a symbol + "
+                                         "params and write the config")
+    p.add_argument("symbol", help="path to a Symbol JSON")
+    p.add_argument("params", help="path to an .npz of name->weight")
+    p.add_argument("--out", required=True, help="output config JSON")
+    p.add_argument("--shape", action="append", default=[],
+                   metavar="NAME=D0,D1,...",
+                   help="data input shape (repeatable); enables the "
+                        "model_error measurement on seeded batches")
+    p.add_argument("--observer", default=None,
+                   choices=("percentile", "absmax"))
+    p.add_argument("--granularity", default=None,
+                   choices=("per_channel", "per_tensor"))
+    p.add_argument("--percentile", type=float, default=99.9)
+    p.add_argument("--tolerance", type=float, default=None,
+                   help="per-layer weight-error guard "
+                        "(default MXTPU_QUANT_ACC_TOL)")
+    p.add_argument("--batches", type=int, default=4)
+    p.set_defaults(fn=cmd_calibrate)
+
+    p = sub.add_parser("show", help="print per-layer scales and "
+                                    "enable/disable decisions")
+    p.add_argument("config", help="QuantConfig JSON")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_show)
+
+    p = sub.add_parser("verify", help="replay the pipeline under the "
+                                      "config; exit 2 unless bytes "
+                                      "strictly drop AND accuracy holds")
+    p.add_argument("symbol", help="path to a Symbol JSON")
+    p.add_argument("params", help="path to an .npz of name->weight")
+    p.add_argument("--config", required=True, help="QuantConfig JSON")
+    p.add_argument("--shape", action="append", default=[],
+                   required=True, metavar="NAME=D0,D1,...")
+    p.add_argument("--mode", default="serving",
+                   choices=("infer", "serving"))
+    p.add_argument("--data-names", default=None,
+                   help="comma list of per-call inputs (default: the "
+                        "--shape names)")
+    p.add_argument("--tolerance", type=float, default=None,
+                   help="accuracy gate (default: the config's)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_verify)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,6 +55,16 @@ files carry the section (a baseline predating round 18 reports the new
 reading without gating). Driver-wrapped BENCH files (``{"parsed":
 {...}}`` envelopes) unwrap transparently everywhere.
 
+Round 19 (quantization): ``diff`` also reads a BENCH file's
+``quantized_serving`` section — the int8-PTQ serving program's bytes
+as a fraction of the f32 pipeline's, and the int8-KV decode step's
+bytes as a fraction of the f32-cache step's — and under
+``--gate-bytes`` gates BOTH ratios when the two files carry the
+section (a pre-r19 baseline reports the new readings ungated, the
+``multichip_fused`` precedent). A growing ratio means quantization is
+buying fewer bytes than it used to — a quantization regression even
+when absolute bytes shrank for other reasons.
+
 Pure file-level operations: no accelerator backend is initialized.
 """
 from __future__ import annotations
@@ -237,6 +247,19 @@ def _load_multichip(tree):
     }
 
 
+def _load_quantized(tree):
+    """The BENCH ``quantized_serving`` section's gateable readings, or
+    None when the file predates round 19 (or the section errored)."""
+    q = tree.get("quantized_serving")
+    if not isinstance(q, dict) or "serving_bytes_ratio" not in q:
+        return None
+    return {
+        "serving_bytes_ratio": q.get("serving_bytes_ratio"),
+        "decode_step_bytes_ratio": q.get("decode_step_bytes_ratio"),
+        "kv_cache_ratio": q.get("kv_cache_ratio"),
+    }
+
+
 def _load_bytes(tree, path):
     """bytes-accessed-per-step from a snapshot (metrics gauge), a
     BENCH JSON (bench.py's ``xla_bytes_accessed_per_step``), or — for
@@ -257,10 +280,16 @@ def _load_bytes(tree, path):
     mc = _load_multichip(tree)
     if mc and mc.get("per_device_step_bytes"):
         return float(mc["per_device_step_bytes"])
+    # quantized-only BENCH file (bench.py quantized_serving standalone
+    # mode): the quantized decode program's step bytes — the program
+    # that run benchmarks
+    q = tree.get("quantized_serving")
+    if isinstance(q, dict) and q.get("decode_step_bytes_int8"):
+        return float(q["decode_step_bytes_int8"])
     sys.exit(f"{path}: no {BYTES_METRIC} metric (and no "
-             "xla_bytes_accessed_per_step or multichip_fused field) — "
-             "not a telemetry snapshot/BENCH file, or the run recorded "
-             "no step costs")
+             "xla_bytes_accessed_per_step, multichip_fused, or "
+             "quantized_serving field) — not a telemetry snapshot/"
+             "BENCH file, or the run recorded no step costs")
 
 
 def _bytes_source(tree):
@@ -280,7 +309,10 @@ def _bytes_source(tree):
         else None
     if isinstance(m, dict) and m.get("value"):
         return "step"
-    return "multichip"
+    mc = _load_multichip(tree)
+    if mc and mc.get("per_device_step_bytes"):
+        return "multichip"
+    return "quantized"
 
 
 def _load_peak_mem(tree, path):
@@ -394,6 +426,32 @@ def cmd_diff(args):
                 entry["baseline"] = "no multichip_fused section in "\
                     f"{args.old} (pre-r18) — reading recorded, not gated"
             result["gate_bytes_multichip"] = entry
+        # round-19 sibling: the quantized_serving section's bytes
+        # RATIOS (quantized program / f32 program) — ratio, not
+        # absolute, so the gate judges what quantization buys
+        # independently of model-size drift. Gated only when BOTH files
+        # carry the section; a pre-r19 baseline reports the new
+        # readings ungated (they become the baseline)
+        old_q, new_q = _load_quantized(old_t), _load_quantized(new_t)
+        if new_q is not None:
+            entry = dict(new_q)
+            orq = (old_q or {}).get("serving_bytes_ratio")
+            nrq = new_q.get("serving_bytes_ratio")
+            odr = (old_q or {}).get("decode_step_bytes_ratio")
+            ndr = new_q.get("decode_step_bytes_ratio")
+            if orq and nrq:
+                entry["old_serving_bytes_ratio"] = orq
+                entry["old_decode_step_bytes_ratio"] = odr
+                entry["regressed"] = bool(
+                    nrq > orq * (1.0 + tol)
+                    or (odr and ndr and ndr > odr * (1.0 + tol)))
+                gate_failed = gate_failed or entry["regressed"]
+            else:
+                entry["regressed"] = False
+                entry["baseline"] = (
+                    "no quantized_serving section in "
+                    f"{args.old} (pre-r19) — reading recorded, not gated")
+            result["gate_bytes_quantized"] = entry
     mem_failed = False
     if args.gate_peak_mem:
         old_m = _load_peak_mem(old_t, args.old)
@@ -452,6 +510,20 @@ def cmd_diff(args):
                           f"replicated "
                           f"{mc['replicated_per_device_bytes']:.6g} "
                           f"(ratio {mc['zero1_ratio']})")
+            q = result.get("gate_bytes_quantized")
+            if q:
+                if "old_serving_bytes_ratio" in q:
+                    print(f"quantized serving bytes ratio: "
+                          f"{q['old_serving_bytes_ratio']:.4f} -> "
+                          f"{q['serving_bytes_ratio']:.4f}; decode step "
+                          f"{q.get('old_decode_step_bytes_ratio')} -> "
+                          f"{q.get('decode_step_bytes_ratio')}")
+                else:
+                    print(f"quantized serving bytes ratio: "
+                          f"{q['serving_bytes_ratio']:.4f}, decode step "
+                          f"{q.get('decode_step_bytes_ratio')}, KV cache "
+                          f"{q.get('kv_cache_ratio')} "
+                          "(new baseline, ungated)")
         if args.gate_peak_mem:
             g = result["gate_peak_mem"]
             print(f"peak HBM: {g['old_peak_bytes']:.6g} -> "
@@ -481,6 +553,19 @@ def cmd_diff(args):
                   "per chip than the baseline (a mesh-pass or "
                   "partitioning regression). Fix it or re-baseline "
                   "deliberately.", file=sys.stderr)
+        q = result.get("gate_bytes_quantized") or {}
+        if q.get("regressed"):
+            print("BYTES REGRESSION (quantized): the int8 serving/"
+                  "decode programs now move a LARGER fraction of the "
+                  f"f32 programs' bytes (serving ratio "
+                  f"{q.get('old_serving_bytes_ratio')} -> "
+                  f"{q.get('serving_bytes_ratio')}, decode step "
+                  f"{q.get('old_decode_step_bytes_ratio')} -> "
+                  f"{q.get('decode_step_bytes_ratio')}) — quantization "
+                  "is buying less than the baseline (a dequantize "
+                  "stopped fusing, or a site stopped quantizing). Fix "
+                  "the pass or re-baseline deliberately.",
+                  file=sys.stderr)
     if mem_failed:
         print(f"PEAK-MEM REGRESSION: {PEAK_MEM_METRIC} grew "
               f"{result['gate_peak_mem']['delta_pct']:+.3f}% (> "
