@@ -475,6 +475,147 @@ def fleet_serving(replicas_list=(1, 2, 4)):
     }
 
 
+def fleet_autoscale():
+    """The r20 self-scaling multi-tenant section: two tenants (a
+    latency tenant and a batch tenant) behind one FleetRouter, a
+    1->8->1 closed-loop client ramp driving the FleetAutoscaler
+    through a full scale cycle, a replica KILL mid-ramp, and one
+    weight hot-swap of the batch tenant under load. Headlines: zero
+    dropped admitted requests (ramp gave_up), zero fresh XLA traces
+    on every spin-up and across the swap, per-tenant p50/p99 and
+    slo_violations (the `tools/telemetry.py diff --gate-slo`
+    baseline), and the scale trajectory."""
+    import tempfile
+    import threading
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import faultinject, serving
+    from mxnet_tpu.serving import FleetAutoscaler, TenantSpec, loadgen
+
+    os.environ.setdefault("MXTPU_COMPILE_CACHE_DIR",
+                          tempfile.mkdtemp(prefix="mxtpu-asc-bench-"))
+    feat = 16
+
+    def pocket_module(prefix, seed):
+        data = mx.sym.Variable("data")
+        fc1 = mx.sym.FullyConnected(data, num_hidden=64,
+                                    name=f"{prefix}_fc1")
+        act = mx.sym.Activation(fc1, act_type="relu",
+                                name=f"{prefix}_relu")
+        fc2 = mx.sym.FullyConnected(act, num_hidden=10,
+                                    name=f"{prefix}_fc2")
+        net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+        mod = mx.mod.Module(context=mx.cpu(), symbol=net)
+        mod.bind(data_shapes=[("data", (8, feat))],
+                 label_shapes=[("softmax_label", (8,))])
+        mx.random.seed(seed)
+        mod.init_params(mx.init.Xavier())
+        return mod
+
+    mod_lat = pocket_module("asc", seed=7)
+    mod_bat = pocket_module("asc", seed=8)    # same arch: shared cache
+    mod_swap = pocket_module("asc", seed=9)   # hot-swap checkpoint
+
+    def factory_for(mod, name):
+        def factory():
+            pred = mod.as_predictor(buckets=(2, 8))
+            return serving.DynamicBatcher(pred, max_wait_us=1000,
+                                          max_queue=64, name=name)
+        return factory
+
+    x = np.random.RandomState(0).rand(2, feat).astype(np.float32)
+    router = serving.FleetRouter(tenants=[
+        TenantSpec("lat", factory=factory_for(mod_lat, "asc-lat"),
+                   slo_class="latency", replicas=1, min_replicas=1,
+                   max_replicas=3, slo_p99_ms=1000.0),
+        TenantSpec("bat", factory=factory_for(mod_bat, "asc-bat"),
+                   slo_class="batch", replicas=1, min_replicas=1,
+                   max_replicas=2)],
+        name="bench-autoscale", probe_interval_s=0.2).start()
+    asc = FleetAutoscaler(router, up_thresh=0.2, down_thresh=0.05,
+                          cooldown_s=0.05, interval_s=0.03,
+                          calm_ticks=3)
+    victim = router._replicas[0].predictor.telemetry_id
+    swap_result = {}
+
+    def swap_mid_ramp():
+        pre = sum(r["retraces"]
+                  for r in router.report()["replicas"])
+        t0 = time.perf_counter()
+        router.swap_weights(tenant="bat", module=mod_swap)
+        swap_result["swap_s"] = round(time.perf_counter() - t0, 4)
+        swap_result["retrace_delta"] = sum(
+            r["retraces"] for r in router.report()["replicas"]) - pre
+
+    swapper = threading.Timer(1.0, swap_mid_ramp)
+    swapper.daemon = True
+    with asc:
+        with faultinject.inject(f"replica_drop:replica={victim}:"
+                                "call=60"):
+            swapper.start()
+            run = loadgen.ramp(
+                router, x, tenants={"lat": 3, "bat": 1},
+                profile={"shape": "step",
+                         "steps": [(0.25, 1), (1.0, 8), (0.25, 1)]},
+                retries=100, backoff_ms=2)
+        swapper.join(timeout=30)
+        deadline = time.monotonic() + 15
+        while (router.healthy_count("lat") > 1
+               or router.healthy_count("bat") > 1) and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+    rep = router.report()
+    arep = asc.report()
+    router.stop()
+
+    tenants = {}
+    for name, t in rep["tenants"].items():
+        tenants[name] = {
+            "slo_class": t["slo_class"],
+            "served": t["served"],
+            "shed": t["shed"],
+            "slo_violations": t["slo_violations"],
+            "swaps": t["swaps"],
+            "p50_ms": t["p50_ms"],
+            "p99_ms": t["p99_ms"],
+        }
+    return {
+        "ramp": {
+            "max_clients": run["max_clients"],
+            "completed": run["completed"],
+            "dropped": run["gave_up"],
+            "req_s": round(run["req_s"], 2),
+            "p50_ms": round(run["p50_ms"], 3),
+            "p99_ms": round(run["p99_ms"], 3),
+        },
+        "tenants": tenants,
+        "scale_ups": arep["scale_ups"],
+        "scale_downs": arep["scale_downs"],
+        "scaleup_failures": arep["scaleup_failures"],
+        "policy_errors": arep["policy_errors"],
+        "spinup_retraces": rep["spinup_retraces"],
+        "replaces": rep["replaces"],
+        "parked": rep["parked"],
+        "swap": {"tenant": "bat",
+                 "swap_s": swap_result.get("swap_s"),
+                 "retrace_delta": swap_result.get("retrace_delta"),
+                 "swaps": rep["swaps"]},
+        "note": "two tenants (latency slo_p99 1000 ms + batch) behind "
+                "one FleetRouter; 1->8->1 stepped client ramp "
+                "(lat:bat 3:1) with the autoscaler armed, the "
+                "latency tenant's original replica replica_drop-"
+                "killed mid-ramp, and one swap_weights of the batch "
+                "tenant under load. dropped = ramp clients that "
+                "exhausted retries (pin 0); spinup_retraces = fresh "
+                "XLA traces per scale-up (pin all 0); swap "
+                "retrace_delta = fresh traces across the hot-swap "
+                "(pin 0); tenants.*.slo_violations baselines "
+                "`telemetry.py diff --gate-slo` (absolute: any "
+                "nonzero fails)",
+    }
+
+
 _MULTICHIP_CHILD = r"""
 import json, os, sys, time
 import numpy as np
@@ -1398,6 +1539,14 @@ print("BENCH " + json.dumps({
     except Exception:
         pass
 
+    # -- autoscaling + multi-tenancy (round 20): chaos-drilled client
+    # ramp, replica kill, hot-swap; the --gate-slo baseline
+    fleet_autoscale_stats = None
+    try:
+        fleet_autoscale_stats = fleet_autoscale()
+    except Exception:
+        pass
+
     # -- multi-chip fused training (round 18): mesh-native passes +
     # ZeRO-1 sharded optimizer, 8-device DP and DP x TP
     multichip_stats = None
@@ -1515,6 +1664,7 @@ print("BENCH " + json.dumps({
         "transformer_serving": transformer_serving_stats,
         "quantized_serving": quantized_serving_stats,
         "fleet_serving": fleet_serving_stats,
+        "fleet_autoscale": fleet_autoscale_stats,
         "multichip_fused": multichip_stats,
         "memory": memory_stats,
         "telemetry": telemetry_snapshot,
@@ -1552,6 +1702,11 @@ if __name__ == "__main__":
         print("BENCH " + json.dumps(
             {"metric": "fleet_serving",
              "fleet_serving": fleet_serving()}))
+    elif len(sys.argv) > 1 and sys.argv[1] == "fleet_autoscale":
+        # standalone fast mode: just the autoscale/multi-tenant section
+        print("BENCH " + json.dumps(
+            {"metric": "fleet_autoscale",
+             "fleet_autoscale": fleet_autoscale()}))
     elif len(sys.argv) > 1 and sys.argv[1] == "multichip_fused":
         # standalone fast mode: just the mesh-native training section
         print("BENCH " + json.dumps(

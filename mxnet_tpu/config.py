@@ -198,6 +198,46 @@ register("MXTPU_FLEET_LAT_WINDOW", 64, int,
          "Per-replica latency samples the router keeps for the "
          "straggler rule (and the minimum is an eighth of it: no "
          "drain verdict off a cold replica's first requests)")
+register("MXTPU_FLEET_SCALE_UP_THRESH", 0.5, float,
+         "FleetAutoscaler scale-up trigger (serving/autoscale.py): "
+         "queued rows above this fraction of the tenant group's total "
+         "micro-batch capacity (healthy x max_batch) — or any recent "
+         "shed — asks for one more replica, hysteresis permitting")
+register("MXTPU_FLEET_SCALE_DOWN_THRESH", 0.05, float,
+         "FleetAutoscaler scale-down trigger: sustained load below "
+         "this fraction of capacity (and zero recent sheds) retires "
+         "one replica via the polite DRAINING path")
+register("MXTPU_FLEET_SCALE_COOLDOWN_S", 1.0, float,
+         "Autoscaler hysteresis: minimum seconds between scale "
+         "decisions for one tenant group (up or down), so a bursty "
+         "queue cannot flap the fleet size")
+register("MXTPU_FLEET_SCALE_INTERVAL_S", 0.25, float,
+         "Autoscaler policy-thread tick interval (signals are read and "
+         "one decision made per tick per tenant group)")
+register("MXTPU_FLEET_MIN_REPLICAS", 1, int,
+         "Autoscaler floor: a tenant group never shrinks below this "
+         "many replicas (TenantSpec.min_replicas overrides per tenant)")
+register("MXTPU_FLEET_MAX_REPLICAS", 4, int,
+         "Autoscaler ceiling: a tenant group never grows past this "
+         "many replicas — past it the degradation ladder engages "
+         "(TenantSpec.max_replicas overrides per tenant)")
+register("MXTPU_FLEET_TENANT_QUOTA", 16, int,
+         "Base admission quota in in-flight requests per unit of "
+         "tenant weight (serving/tenancy.py): a tenant may hold "
+         "weight x this many requests in flight before its submits "
+         "shed — the weighted-fair bound that keeps a batch tenant "
+         "from starving a latency tenant")
+register("MXTPU_FLEET_REDISPATCH_GRACE_S", 5.0, float,
+         "How long an ADMITTED request with no deadline may park "
+         "waiting for a healthy replica when re-dispatch finds none "
+         "(replica condemned, replacement still STARTING) before the "
+         "router gives up and sheds it — admitted requests ride out "
+         "transient zero-capacity windows instead of dropping")
+register("MXTPU_FLEET_DEGRADE_WAIT_FACTOR", 4.0, float,
+         "Degradation-ladder rung 2: multiply every live batcher's "
+         "max_wait_us by this factor while overloaded at max scale "
+         "(bigger batches, higher latency, more throughput); restored "
+         "on de-escalation")
 register("MXTPU_FLEET_HEARTBEAT_S", 0.5, float,
          "Elastic-training heartbeat lease renewal interval "
          "(parallel/elastic.py): each rank republishes its lease in "

@@ -5,7 +5,7 @@ site (``ckpt_write``, ``nan_grad``, ``data_iter``, ``data_worker``,
 ``dist_drop``, ``dist_init``, ``ckpt_truncate``, ``compile_cache``,
 ``telemetry_write``, ``sparse_update``, ``slow_step``,
 ``tune_trial``, ``decode_step``, ``replica_drop``,
-``heartbeat_miss``) plus
+``heartbeat_miss``, ``scale_up``, ``tenant_admit``) plus
 the exact coordinate at which it fires (byte offset, step index, batch
 index, call ordinal). ``telemetry_write`` is consulted by the durable
 telemetry exporter (telemetry/export.py) on every event append
@@ -51,7 +51,17 @@ PERMANENTLY dead — the in-process replica-loss drill the FleetRouter
 renewal (parallel/elastic.py): armed with ``times=K`` it suppresses K
 consecutive renewals, so the OTHER ranks see this rank's lease go
 stale and trigger the mesh re-form — the lost-worker detection drill
-without an actual kill. The same spec
+without an actual kill. ``scale_up`` is consulted by every
+``FleetRouter.scale_up`` spin-up (serving/fleet.py) before the replica
+factory runs (``tenant=<name>``, ``call=N``): a raise fails that
+spin-up attempt — the autoscaler (serving/autoscale.py) must count it,
+retry with exponential backoff, and keep its policy thread alive —
+while ``action=sleep:ms=N`` stretches the spin-up (the hung-provision
+drill). ``tenant_admit`` is consulted at every tenant-routed
+``FleetRouter.submit`` admission (``tenant=<name>``): a fire sheds
+that request cleanly with the tenant-tagged shed counter — the
+admission-failure drill proving a broken tenant never poisons its
+neighbors. The same spec
 always produces the same failure, so CI chaos suites are reproducible
 bit-for-bit (contrast: the classic chaos-monkey coin flip, useless as a
 regression gate).

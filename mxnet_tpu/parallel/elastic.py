@@ -40,10 +40,28 @@ FleetRouter (serving/fleet.py) uses for replicas:
   shared persistent compile cache (``MXTPU_COMPILE_CACHE_DIR``) and
   catches up without a single fresh XLA compile.
 
-Scope: the supervisor relaunches on ONE host (the multi-process drill
-topology); rank 0 doubles as coordinator host, so its loss takes the
-coordination service with it — a cluster scheduler's restart policy
-owns that case (documented in failure_recovery.md).
+Scope: :class:`ElasticSupervisor` relaunches on ONE host (the
+multi-process drill topology); rank 0 doubles as coordinator host, so
+its loss takes the coordination service with it — a cluster
+scheduler's restart policy owns that case (documented in
+failure_recovery.md).
+
+Round 20 adds the MULTI-HOST half of the contract:
+:class:`SupervisorSpec` pins down, as files under a shared workdir,
+exactly what a per-host supervisor must agree on with its peers —
+generation counter, world size, coordinator address, and a per-host
+rank file — and :class:`HostSupervisor` is the per-host agent that
+speaks it: host 0 computes membership from the alive leases and
+publishes ``control.json`` per generation, every host launches only
+its own ranks with the handshake env
+(:meth:`SupervisorSpec.handshake_env`), and a WHOLE-host loss (its
+alive lease goes stale, its exit codes never land) shrinks the next
+generation just like a single lost rank does. Workers machine-check
+the handshake with :meth:`SupervisorSpec.check_env` — a worker whose
+env disagrees with its host's published rank file fails fast with the
+mismatch named, instead of joining the wrong mesh and corrupting a
+collective. The 2-host drill (tests/test_autoscale.py) SIGKILLs one
+whole "host" (a subprocess tree) mid-generation and pins the re-form.
 """
 from __future__ import annotations
 
@@ -59,7 +77,8 @@ from ..checkpoint import CheckpointManager
 
 __all__ = ["REFORM_EXIT", "WorldChanged", "HeartbeatLease",
            "ElasticGuard", "ElasticCheckpointManager", "prepare_resume",
-           "ElasticSupervisor", "generation_from_env", "exit_for_reform"]
+           "ElasticSupervisor", "SupervisorSpec", "HostSupervisor",
+           "generation_from_env", "exit_for_reform"]
 
 # exit code a survivor uses to ask its supervisor for a mesh re-form
 # (chosen clear of shell/signal codes: 0=done, 1=error, 128+N=signal)
@@ -502,3 +521,392 @@ def generation_from_env(default=0):
         return int(os.environ.get("MXTPU_ELASTIC_GENERATION", default))
     except ValueError:
         return int(default)
+
+
+# -- multi-host supervisor contract (round 20) --------------------------------
+
+class SupervisorSpec:
+    """The machine-checked contract between per-host supervisors and
+    their workers, pinned down as files under ``<workdir>/supervisor``:
+
+    - ``control.json`` — host 0 publishes it once per generation
+      (atomic tmp+rename): ``{"generation", "world", "coordinator",
+      "ranks": {host_id: [ranks]}, "status": run|done|failed}``,
+    - ``host<id>.alive`` — each host's liveness lease, re-touched every
+      ``lease_s / 3``; a lease older than ``3 x lease_s`` means the
+      WHOLE host (and every rank on it) is lost,
+    - ``g<gen>/host<id>.ranks.json`` — the per-host rank file: exactly
+      the ranks this host launched this generation. Workers validate
+      their env against it via :meth:`check_env`,
+    - ``g<gen>/host<id>.codes.json`` — the host's exit codes, how
+      host 0 gathers a generation's outcome.
+
+    The handshake env a worker receives is :meth:`handshake_env`: the
+    four single-host vars (``COORDINATOR_ADDRESS`` / ``NUM_PROCESSES``
+    / ``PROCESS_ID`` / ``MXTPU_ELASTIC_GENERATION``) plus
+    ``MXTPU_SUPERVISOR_DIR`` and ``MXTPU_SUPERVISOR_HOST`` so
+    :meth:`check_env` can find the contract and the worker's host."""
+
+    def __init__(self, workdir, hosts=2, procs_per_host=1,
+                 lease_s=None):
+        from .. import config
+        self.workdir = str(workdir)
+        self.hosts = int(hosts)
+        self.procs_per_host = int(procs_per_host)
+        self.lease_s = float(
+            lease_s if lease_s is not None
+            else config.get("MXTPU_FLEET_LEASE_S", 10.0))
+        self.root = os.path.join(self.workdir, "supervisor")
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- paths -----------------------------------------------------------------
+    @property
+    def control_path(self):
+        return os.path.join(self.root, "control.json")
+
+    def alive_path(self, host_id):
+        return os.path.join(self.root, f"host{host_id}.alive")
+
+    def gen_dir(self, generation):
+        return os.path.join(self.root, f"g{generation}")
+
+    def ranks_path(self, generation, host_id):
+        return os.path.join(self.gen_dir(generation),
+                            f"host{host_id}.ranks.json")
+
+    def codes_path(self, generation, host_id):
+        return os.path.join(self.gen_dir(generation),
+                            f"host{host_id}.codes.json")
+
+    # -- contract I/O ----------------------------------------------------------
+    @staticmethod
+    def _write_json(path, obj):
+        import json
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    @staticmethod
+    def _read_json(path):
+        import json
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def write_control(self, control):
+        self._write_json(self.control_path, control)
+
+    def read_control(self):
+        return self._read_json(self.control_path)
+
+    def touch_alive(self, host_id):
+        path = self.alive_path(host_id)
+        with open(path, "a"):
+            os.utime(path, None)
+
+    def host_alive(self, host_id):
+        """Fresh-enough alive lease? Stale past ``3 x lease_s`` (or
+        never touched) means the whole host is lost."""
+        try:
+            age = time.time() - os.path.getmtime(self.alive_path(host_id))
+        except OSError:
+            return False
+        return age <= 3.0 * self.lease_s
+
+    def write_ranks(self, generation, host_id, ranks, world,
+                    coordinator):
+        self._write_json(self.ranks_path(generation, host_id),
+                         {"generation": int(generation),
+                          "world": int(world),
+                          "coordinator": coordinator,
+                          "ranks": [int(r) for r in ranks]})
+
+    def write_codes(self, generation, host_id, codes):
+        self._write_json(self.codes_path(generation, host_id),
+                         {"codes": [int(c) for c in codes]})
+
+    def read_codes(self, generation, host_id):
+        obj = self._read_json(self.codes_path(generation, host_id))
+        return None if obj is None else obj.get("codes")
+
+    # -- worker handshake ------------------------------------------------------
+    def handshake_env(self, rank, world, generation, coordinator,
+                      host_id):
+        return {
+            "COORDINATOR_ADDRESS": coordinator,
+            "NUM_PROCESSES": str(int(world)),
+            "PROCESS_ID": str(int(rank)),
+            "MXTPU_ELASTIC_GENERATION": str(int(generation)),
+            "MXTPU_SUPERVISOR_DIR": self.workdir,
+            "MXTPU_SUPERVISOR_HOST": str(int(host_id)),
+        }
+
+    @staticmethod
+    def check_env(environ=None):
+        """Worker-side machine check of the supervisor handshake.
+
+        No-op (returns None) when not running under a
+        :class:`HostSupervisor` (``MXTPU_SUPERVISOR_DIR`` unset).
+        Otherwise validates this worker's env against its host's
+        published rank file — generation, world size, coordinator, and
+        rank membership must all agree — and raises :class:`MXNetError`
+        naming the first mismatch. Returns the validated identity dict
+        ``{"rank", "world", "generation", "host", "coordinator"}``."""
+        environ = os.environ if environ is None else environ
+        workdir = environ.get("MXTPU_SUPERVISOR_DIR")
+        if not workdir:
+            return None
+        spec = SupervisorSpec(workdir)
+        host = int(environ.get("MXTPU_SUPERVISOR_HOST", -1))
+        rank = int(environ.get("PROCESS_ID", -1))
+        world = int(environ.get("NUM_PROCESSES", -1))
+        gen = int(environ.get("MXTPU_ELASTIC_GENERATION", -1))
+        coord = environ.get("COORDINATOR_ADDRESS")
+        rec = spec._read_json(spec.ranks_path(gen, host))
+        if rec is None:
+            raise MXNetError(
+                f"supervisor handshake: no rank file for host {host} "
+                f"generation {gen} under {spec.root}")
+        for field, got, want in (
+                ("generation", gen, rec.get("generation")),
+                ("world", world, rec.get("world")),
+                ("coordinator", coord, rec.get("coordinator"))):
+            if got != want:
+                raise MXNetError(
+                    f"supervisor handshake mismatch: env {field}={got!r}"
+                    f" but host {host}'s rank file says {want!r}")
+        if rank not in rec.get("ranks", []):
+            raise MXNetError(
+                f"supervisor handshake mismatch: rank {rank} not in "
+                f"host {host}'s rank file {rec.get('ranks')} for "
+                f"generation {gen}")
+        return {"rank": rank, "world": world, "generation": gen,
+                "host": host, "coordinator": coord}
+
+
+class HostSupervisor:
+    """Per-host agent of the :class:`SupervisorSpec` contract: the
+    multi-host twin of :class:`ElasticSupervisor`.
+
+    Every host renews its alive lease and launches ONLY its own ranks
+    each generation. Host 0 is additionally the controller: it computes
+    membership from the alive leases (a stale lease = whole-host loss,
+    all its ranks gone at once), assigns contiguous ranks across live
+    hosts, publishes ``control.json``, gathers per-host exit codes, and
+    decides done / re-form / failed exactly like the single-host
+    supervisor — REFORM_EXIT or lost ranks shrink the next generation;
+    a clean sweep of zeros finishes.
+
+    ``argv_fn(rank, world, generation, coordinator)`` builds one
+    worker's command line (same signature as
+    :class:`ElasticSupervisor`)."""
+
+    def __init__(self, spec, host_id, argv_fn, env=None, timeout_s=240,
+                 max_generations=6, min_world=1, port_fn=None,
+                 logger=None):
+        self.spec = spec
+        self.host_id = int(host_id)
+        self.argv_fn = argv_fn
+        self.env = dict(env) if env else dict(os.environ)
+        self.timeout_s = float(timeout_s)
+        self.max_generations = int(max_generations)
+        self.min_world = int(min_world)
+        self._port_fn = port_fn or ElasticSupervisor._free_port
+        import logging
+        self.logger = logger or logging.getLogger("mxnet_tpu.elastic")
+        self.history = []
+        self._dead = set()      # hosts declared lost (no rejoin here)
+        self._stop_lease = threading.Event()
+        self._lease_thread = None
+
+    # -- alive lease -----------------------------------------------------------
+    def _lease_loop(self):
+        while not self._stop_lease.wait(self.spec.lease_s / 3.0):
+            try:
+                self.spec.touch_alive(self.host_id)
+            except OSError:
+                pass
+
+    def _start_lease(self):
+        self.spec.touch_alive(self.host_id)
+        self._lease_thread = threading.Thread(
+            target=self._lease_loop,
+            name=f"host{self.host_id}-alive", daemon=True)
+        self._lease_thread.start()
+
+    def _stop_lease_thread(self):
+        self._stop_lease.set()
+        if self._lease_thread is not None:
+            self._lease_thread.join(timeout=self.spec.lease_s)
+            self._lease_thread = None
+
+    # -- worker launch ---------------------------------------------------------
+    def _run_ranks(self, ctrl):
+        gen = ctrl["generation"]
+        world = ctrl["world"]
+        coordinator = ctrl["coordinator"]
+        ranks = ctrl["ranks"].get(str(self.host_id),
+                                  ctrl["ranks"].get(self.host_id, []))
+        self.spec.write_ranks(gen, self.host_id, ranks, world,
+                              coordinator)
+        procs = []
+        for rank in ranks:
+            env = dict(self.env)
+            env.pop("MXTPU_FAULT_INJECT", None)
+            env.update(self.spec.handshake_env(
+                rank, world, gen, coordinator, self.host_id))
+            procs.append(subprocess.Popen(
+                self.argv_fn(rank, world, gen, coordinator), env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        codes, logs = [], []
+        deadline = time.monotonic() + self.timeout_s
+        for p in procs:
+            try:
+                out, _ = p.communicate(
+                    timeout=max(1.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            codes.append(p.returncode)
+            logs.append((out or b"").decode(errors="replace"))
+        self.spec.write_codes(gen, self.host_id, codes)
+        return ranks, codes, logs
+
+    # -- controller (host 0) ---------------------------------------------------
+    def _live_hosts(self):
+        return [h for h in range(self.spec.hosts)
+                if h not in self._dead
+                and (h == self.host_id or self.spec.host_alive(h))]
+
+    def _gather_codes(self, gen, member_hosts, own_codes):
+        """Wait for every member host's codes file; a host whose file
+        never lands AND whose alive lease went stale is a whole-host
+        loss — its ranks all count as lost."""
+        got = {self.host_id: own_codes}
+        lost_hosts = []
+        deadline = time.monotonic() + self.timeout_s
+        pending = [h for h in member_hosts if h != self.host_id]
+        while pending and time.monotonic() < deadline:
+            for h in list(pending):
+                codes = self.spec.read_codes(gen, h)
+                if codes is not None:
+                    got[h] = codes
+                    pending.remove(h)
+                elif not self.spec.host_alive(h):
+                    lost_hosts.append(h)
+                    pending.remove(h)
+            if pending:
+                time.sleep(0.1)
+        lost_hosts.extend(pending)     # deadline: treat as lost
+        return got, sorted(set(lost_hosts))
+
+    def _run_controller(self):
+        assert self.host_id == 0, "only host 0 controls the fleet"
+        world = None
+        for gen in range(self.max_generations):
+            # membership from alive leases; give stragglers one lease
+            # to publish theirs on the first generation
+            if gen == 0:
+                deadline = time.monotonic() + 3.0 * self.spec.lease_s
+                while len(self._live_hosts()) < self.spec.hosts and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.05)
+            hosts = self._live_hosts()
+            ranks, nxt = {}, 0
+            for h in hosts:
+                ranks[str(h)] = list(range(
+                    nxt, nxt + self.spec.procs_per_host))
+                nxt += self.spec.procs_per_host
+            world = nxt
+            if world < self.min_world:
+                raise MXNetError(
+                    f"supervisor: world shrank to {world} < min_world="
+                    f"{self.min_world} at generation {gen}")
+            coordinator = f"127.0.0.1:{self._port_fn()}"
+            ctrl = {"generation": gen, "world": world,
+                    "coordinator": coordinator, "ranks": ranks,
+                    "status": "run"}
+            self.spec.write_control(ctrl)
+            self.logger.info(
+                "supervisor gen %d: hosts=%s world=%d (%s)",
+                gen, hosts, world, coordinator)
+            _, own_codes, logs = self._run_ranks(ctrl)
+            codes_by_host, lost_hosts = self._gather_codes(
+                gen, hosts, own_codes)
+            self._dead.update(lost_hosts)
+            all_codes = [c for h in sorted(codes_by_host)
+                         for c in codes_by_host[h]]
+            dead = [r for h in lost_hosts for r in ranks[str(h)]]
+            for h, cs in codes_by_host.items():
+                for i, c in enumerate(cs):
+                    if c not in (0, REFORM_EXIT):
+                        dead.append(ranks[str(h)][i])
+            lost_ranks = sorted(set(dead))
+            record = {"generation": gen, "world": world,
+                      "hosts": hosts, "ranks": ranks,
+                      "codes": {h: codes_by_host.get(h)
+                                for h in hosts},
+                      "lost_hosts": lost_hosts,
+                      "lost_ranks": lost_ranks, "logs": logs}
+            self.history.append(record)
+            if codes_by_host and not lost_hosts and \
+                    all(c == 0 for c in all_codes):
+                record["outcome"] = "done"
+                ctrl["status"] = "done"
+                self.spec.write_control(ctrl)
+                return self.history
+            if not lost_hosts and not lost_ranks and \
+                    not any(c == REFORM_EXIT for c in all_codes):
+                record["outcome"] = "failed"
+                ctrl["status"] = "failed"
+                self.spec.write_control(ctrl)
+                raise MXNetError(
+                    f"supervisor gen {gen}: workers failed without "
+                    f"requesting re-form (codes={codes_by_host});\n"
+                    + "\n".join(logs))
+            record["outcome"] = "reform"
+        raise MXNetError(
+            f"supervisor: no generation finished within "
+            f"{self.max_generations} re-forms")
+
+    # -- follower (host > 0) ---------------------------------------------------
+    def _run_follower(self):
+        seen = -1
+        deadline = time.monotonic() + \
+            self.timeout_s * self.max_generations
+        while time.monotonic() < deadline:
+            ctrl = self.spec.read_control()
+            if ctrl is None or ctrl["generation"] <= seen:
+                time.sleep(0.05)
+                continue
+            if ctrl.get("status") in ("done", "failed"):
+                return self.history
+            seen = ctrl["generation"]
+            if str(self.host_id) not in ctrl["ranks"]:
+                # not a member this generation (we were declared lost);
+                # keep the lease warm so a future rejoin can include us
+                time.sleep(0.05)
+                continue
+            ranks, codes, logs = self._run_ranks(ctrl)
+            self.history.append(
+                {"generation": seen, "ranks": ranks, "codes": codes,
+                 "logs": logs})
+        return self.history
+
+    def run(self):
+        """Drive this host's half of the contract until the fleet
+        finishes (host 0 returns the full history; followers return
+        their own launch records)."""
+        self._start_lease()
+        try:
+            if self.host_id == 0:
+                return self._run_controller()
+            return self._run_follower()
+        finally:
+            self._stop_lease_thread()

@@ -34,9 +34,9 @@ import weakref
 
 from ..base import MXNetError
 
-__all__ = ["Predictor", "DynamicBatcher", "FleetRouter", "ServingError",
-           "Overloaded", "DeadlineExceeded", "Cancelled",
-           "serving_report", "decode"]
+__all__ = ["Predictor", "DynamicBatcher", "FleetRouter", "TenantSpec",
+           "FleetAutoscaler", "ServingError", "Overloaded",
+           "DeadlineExceeded", "Cancelled", "serving_report", "decode"]
 
 
 class ServingError(MXNetError):
@@ -159,3 +159,5 @@ from .batcher import DynamicBatcher        # noqa: E402
 from . import loadgen                      # noqa: E402
 from . import decode                       # noqa: E402
 from .fleet import FleetRouter             # noqa: E402
+from .tenancy import TenantSpec            # noqa: E402
+from .autoscale import FleetAutoscaler     # noqa: E402

@@ -1,0 +1,346 @@
+"""Queue-driven fleet autoscaling with a hard-degradation ladder.
+
+The :class:`FleetAutoscaler` is a policy loop over the signals a
+:class:`~mxnet_tpu.serving.fleet.FleetRouter` already exposes — per
+tenant: queued rows vs micro-batch capacity, the shed counter, healthy
+replica count (``router.signals``). It never touches a request path;
+it only calls the router's elastic-slot verbs (``scale_up`` /
+``scale_down``), so every scaling decision inherits their guarantees:
+spin-ups are AOT loads from the shared compile cache (0 fresh traces,
+pinned by the drills) and scale-downs always retire through DRAINING
+(zero dropped in-flight requests).
+
+Policy, per tenant per tick:
+
+- **up** when queue load exceeds ``MXTPU_FLEET_SCALE_UP_THRESH`` or the
+  tenant shed since the last tick, the group is below its
+  ``max_replicas``, and the cooldown has elapsed. A failed spin-up
+  (the ``scale_up`` fault site, a flaky provisioner) is counted and
+  retried with exponential backoff — the policy thread never wedges on
+  a broken factory.
+- **down** when load has stayed below ``MXTPU_FLEET_SCALE_DOWN_THRESH``
+  with zero sheds for ``calm_ticks`` consecutive ticks and the group
+  is above ``min_replicas``. Scale-down is always the polite path.
+
+**Degradation ladder** — when a tenant is overloaded (shedding) while
+already pinned at max scale, adding capacity is off the table, so the
+autoscaler degrades service in priority order, one rung per tick, each
+rung counted in the registry (``fleet::<id>::degrade::*``):
+
+1. ``shed_tenant`` — close admission for the LOWEST-priority tenant
+   (its ledger's ``degraded_shed`` flag; a batch tenant is sacrificed
+   before a latency tenant feels anything),
+2. ``longer_wait`` — multiply every live batcher's ``max_wait_us`` by
+   ``MXTPU_FLEET_DEGRADE_WAIT_FACTOR`` (bigger batches, better
+   throughput, worse tail latency),
+3. ``overloaded`` — the fleet-level breaker: every submit sheds with
+   ``Overloaded`` until pressure subsides.
+
+The ladder unwinds in reverse, one rung per calm streak, so recovery
+is as observable as degradation. ``tick()`` is public and takes an
+optional clock so tests drive the whole policy deterministically;
+``start()`` runs the same tick on a daemon thread every
+``MXTPU_FLEET_SCALE_INTERVAL_S``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import config
+from ..base import MXNetError
+
+__all__ = ["FleetAutoscaler"]
+
+_BACKOFF_BASE_S = 0.05
+_BACKOFF_CAP_S = 5.0
+
+
+class _TenantPolicy:
+    """Per-tenant-group policy state: shed watermark, cooldown clock,
+    calm-streak counter, and the spin-up retry backoff."""
+
+    def __init__(self):
+        self.last_shed = 0
+        self.last_scale = None   # monotonic time of last successful scale
+        self.calm = 0            # consecutive ticks below down_thresh
+        self.fails = 0           # consecutive failed spin-up attempts
+        self.retry_at = 0.0      # backoff gate for the next attempt
+
+
+class FleetAutoscaler:
+    """Drive a router's replica counts from its queue signals.
+
+    Parameters
+    ----------
+    router : FleetRouter
+        Started router to scale. Per-tenant min/max bounds come from
+        each :class:`TenantSpec` (themselves defaulted from
+        ``MXTPU_FLEET_{MIN,MAX}_REPLICAS``).
+    up_thresh / down_thresh : float, optional
+        Queue-load (queued rows / micro-batch capacity) hysteresis
+        band (defaults ``MXTPU_FLEET_SCALE_{UP,DOWN}_THRESH``).
+    cooldown_s : float, optional
+        Minimum seconds between successful scale actions for one
+        tenant group (default ``MXTPU_FLEET_SCALE_COOLDOWN_S``).
+    interval_s : float, optional
+        Daemon-thread tick period (default
+        ``MXTPU_FLEET_SCALE_INTERVAL_S``).
+    calm_ticks : int
+        Consecutive calm ticks required before scaling down or
+        unwinding a degradation rung.
+    """
+
+    def __init__(self, router, up_thresh=None, down_thresh=None,
+                 cooldown_s=None, interval_s=None, calm_ticks=3):
+        self.router = router
+        self.up_thresh = float(
+            up_thresh if up_thresh is not None
+            else config.get("MXTPU_FLEET_SCALE_UP_THRESH", 0.5))
+        self.down_thresh = float(
+            down_thresh if down_thresh is not None
+            else config.get("MXTPU_FLEET_SCALE_DOWN_THRESH", 0.05))
+        if self.down_thresh >= self.up_thresh:
+            raise MXNetError(
+                f"autoscaler needs down_thresh < up_thresh, got "
+                f"{self.down_thresh} >= {self.up_thresh}")
+        self.cooldown_s = float(
+            cooldown_s if cooldown_s is not None
+            else config.get("MXTPU_FLEET_SCALE_COOLDOWN_S", 1.0))
+        self.interval_s = float(
+            interval_s if interval_s is not None
+            else config.get("MXTPU_FLEET_SCALE_INTERVAL_S", 0.25))
+        self.calm_ticks = int(calm_ticks)
+        self._wait_factor = float(
+            config.get("MXTPU_FLEET_DEGRADE_WAIT_FACTOR", 4.0))
+        self._policies = {}        # tenant -> _TenantPolicy
+        self._lock = threading.Lock()
+        self._thread = None
+        self._stop = threading.Event()
+        # ladder state
+        self.degrade_rung = 0
+        self._shed_tenant = None        # rung 1's victim
+        self._saved_waits = []          # rung 2: [(batcher, original us)]
+        self._degrade_calm = 0
+        # counters
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.scaleup_failures = 0
+        self.policy_errors = 0
+        self.scale_events = []
+        from ..telemetry import registry as treg
+        fid = router.telemetry_id
+        self._c_shed_tenant = treg.counter(
+            f"fleet::{fid}::degrade::shed_tenant")
+        self._c_longer_wait = treg.counter(
+            f"fleet::{fid}::degrade::longer_wait")
+        self._c_overloaded = treg.counter(
+            f"fleet::{fid}::degrade::overloaded")
+
+    # -- lifecycle -------------------------------------------------------------
+    def start(self):
+        """Run ``tick()`` on a daemon thread every ``interval_s``."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-autoscaler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                # the policy thread survives anything — a wedged
+                # autoscaler is worse than a missed tick
+                with self._lock:
+                    self.policy_errors += 1
+
+    # -- the policy ------------------------------------------------------------
+    def tick(self, now=None):
+        """One policy pass over every tenant group. ``now`` (a
+        monotonic-clock stand-in) lets tests run the cooldown and
+        backoff logic on a synthetic clock. Returns the list of events
+        this tick appended to ``scale_events``."""
+        if now is None:
+            now = time.monotonic()
+        before = len(self.scale_events)
+        pinned_overloaded = False
+        for tname in list(self.router._tenants):
+            try:
+                if self._tick_tenant(tname, now):
+                    pinned_overloaded = True
+            except Exception:
+                with self._lock:
+                    self.policy_errors += 1
+        self._tick_ladder(pinned_overloaded, now)
+        return self.scale_events[before:]
+
+    def _tick_tenant(self, tname, now):
+        """Policy for one tenant group. Returns True when the group is
+        overloaded while pinned at max scale (ladder input)."""
+        sig = self.router.signals(tname)
+        spec = self.router._tenants[tname].spec
+        pol = self._policies.setdefault(tname, _TenantPolicy())
+        load = sig["queued_rows"] / sig["capacity"]
+        shed_delta = sig["shed"] - pol.last_shed
+        pol.last_shed = sig["shed"]
+        want_up = load > self.up_thresh or shed_delta > 0
+        cooled = pol.last_scale is None or \
+            now - pol.last_scale >= self.cooldown_s
+
+        if want_up:
+            pol.calm = 0
+            if sig["healthy"] >= spec.max_replicas:
+                return shed_delta > 0    # pinned at max and still shedding
+            if not cooled or now < pol.retry_at:
+                return False
+            try:
+                slot = self.router.scale_up(tname)
+            except Exception as e:
+                with self._lock:
+                    self.scaleup_failures += 1
+                pol.fails += 1
+                pol.retry_at = now + min(
+                    _BACKOFF_CAP_S,
+                    _BACKOFF_BASE_S * (2 ** (pol.fails - 1)))
+                self._event("scale_up_failed", now, tenant=tname,
+                            error=str(e), fails=pol.fails)
+                return False
+            pol.fails = 0
+            pol.retry_at = 0.0
+            pol.last_scale = now
+            with self._lock:
+                self.scale_ups += 1
+            self._event("scale_up", now, tenant=tname, slot=slot,
+                        healthy=sig["healthy"] + 1,
+                        load=round(load, 4), shed_delta=shed_delta)
+            return False
+
+        calm = load < self.down_thresh and shed_delta == 0 and \
+            sig["inflight"] == 0
+        pol.calm = pol.calm + 1 if calm else 0
+        if pol.calm >= self.calm_ticks and cooled and \
+                sig["healthy"] > spec.min_replicas and \
+                self.degrade_rung == 0:
+            slot = self.router.scale_down(tenant=tname)
+            if slot is not None:
+                pol.calm = 0
+                pol.last_scale = now
+                with self._lock:
+                    self.scale_downs += 1
+                self._event("scale_down", now, tenant=tname, slot=slot,
+                            healthy=sig["healthy"] - 1,
+                            load=round(load, 4))
+        return False
+
+    # -- degradation ladder ----------------------------------------------------
+    def _tick_ladder(self, pinned_overloaded, now):
+        if pinned_overloaded:
+            self._degrade_calm = 0
+            if self.degrade_rung < 3:
+                self._escalate(now)
+        else:
+            self._degrade_calm += 1
+            if self.degrade_rung > 0 and \
+                    self._degrade_calm >= self.calm_ticks:
+                self._degrade_calm = 0
+                self._deescalate(now)
+
+    def _escalate(self, now):
+        self.degrade_rung += 1
+        rung = self.degrade_rung
+        if rung == 1:
+            # sacrifice the lowest-priority tenant first
+            victim = min(self.router._tenants.values(),
+                         key=lambda led: led.spec.priority)
+            with self.router._lock:
+                victim.degraded_shed = True
+            self._shed_tenant = victim.spec.name
+            self._c_shed_tenant.inc()
+            self._event("degrade", now, rung=1, action="shed_tenant",
+                        tenant=victim.spec.name)
+        elif rung == 2:
+            with self.router._lock:
+                reps = [r for r in self.router._replicas
+                        if r is not None]
+            self._saved_waits = []
+            for r in reps:
+                b = r.batcher
+                if hasattr(b, "max_wait_us"):
+                    self._saved_waits.append((b, b.max_wait_us))
+                    b.max_wait_us = int(b.max_wait_us *
+                                        self._wait_factor)
+            self._c_longer_wait.inc()
+            self._event("degrade", now, rung=2, action="longer_wait",
+                        factor=self._wait_factor)
+        elif rung == 3:
+            with self.router._lock:
+                self.router._degrade_overload = True
+            self._c_overloaded.inc()
+            self._event("degrade", now, rung=3, action="overloaded")
+
+    def _deescalate(self, now):
+        rung = self.degrade_rung
+        if rung == 3:
+            with self.router._lock:
+                self.router._degrade_overload = False
+            self._event("restore", now, rung=3, action="overloaded")
+        elif rung == 2:
+            for b, us in self._saved_waits:
+                b.max_wait_us = us
+            self._saved_waits = []
+            self._event("restore", now, rung=2, action="longer_wait")
+        elif rung == 1:
+            if self._shed_tenant is not None:
+                led = self.router._tenants.get(self._shed_tenant)
+                if led is not None:
+                    with self.router._lock:
+                        led.degraded_shed = False
+                self._event("restore", now, rung=1,
+                            action="shed_tenant",
+                            tenant=self._shed_tenant)
+                self._shed_tenant = None
+        self.degrade_rung = rung - 1
+
+    # -- observability ---------------------------------------------------------
+    def _event(self, kind, now, **fields):
+        ev = {"event": kind, "t": round(now, 4)}
+        ev.update(fields)
+        with self._lock:
+            self.scale_events.append(ev)
+        from ..telemetry import export as _texp
+        if _texp.enabled():
+            _texp.emit_event("fleet_autoscale_" + kind,
+                             router=self.router.telemetry_id, **fields)
+
+    def report(self, reset=False):
+        with self._lock:
+            out = {
+                "scale_ups": self.scale_ups,
+                "scale_downs": self.scale_downs,
+                "scaleup_failures": self.scaleup_failures,
+                "policy_errors": self.policy_errors,
+                "degrade_rung": self.degrade_rung,
+                "shed_tenant": self._shed_tenant,
+                "events": list(self.scale_events),
+            }
+            if reset:
+                self.scale_ups = self.scale_downs = 0
+                self.scaleup_failures = self.policy_errors = 0
+                self.scale_events = []
+        return out

@@ -44,17 +44,43 @@ replica-assigned ``trace_id`` and every route/redispatch/shed lands as
 a ``fleet_*`` telemetry event under it, so ``tools/telemetry.py
 fleet`` can render whole-fleet request timelines and a Chrome trace
 shows fleet:request -> serving:batch -> serving:bucket as one tree.
+
+Round 20 grows the fixed formation into a self-scaling multi-tenant
+fleet:
+
+- **tenancy** — ``FleetRouter(tenants=[TenantSpec(...), ...])`` runs N
+  models x M replicas behind one router; ``submit(tenant=...)`` routes
+  within that tenant's replica group, admission enforces the
+  weighted-fair per-tenant quota (serving/tenancy.py), and every
+  tenant gets its own ``serving::tenant::<name>::`` latency/shed/SLO
+  registry series. A single-model router is just the one-tenant
+  degenerate case — the r17 API is unchanged.
+- **elastic slots** — ``scale_up(tenant)`` spins a new replica into a
+  vacant slot (AOT cache load, retrace count recorded — the 0-fresh-
+  traces pin) and ``scale_down(slot)`` retires one through the polite
+  DRAINING path, vacating the slot and dropping the dead replica's
+  registry series EAGERLY (not at GC), so autoscale churn never grows
+  ``mx.telemetry.report()``. The policy thread deciding when lives in
+  serving/autoscale.py.
+- **weight hot-swap** — ``swap_weights(tenant, arg_params)`` restages
+  a new checkpoint's params replica-by-replica: each replica stops
+  taking new work (DRAINING), serves out its queue, restages params as
+  program *arguments* under the predictor lock (r19's compile-key
+  discipline: same symbol -> same executable -> ZERO recompiles), and
+  rejoins — zero dropped requests, bit-identical afterwards to a fleet
+  freshly started on the new checkpoint.
 """
 from __future__ import annotations
 
 import threading
 import time
 
-from .. import config
+from .. import config, faultinject
 from ..base import MXNetError
 from ..telemetry import trace as _trace
 from . import DeadlineExceeded, Overloaded, _register_router
 from .batcher import ServingFuture
+from .tenancy import DEFAULT_TENANT, TenantSpec, _TenantLedger
 
 __all__ = ["FleetRouter"]
 
@@ -70,9 +96,10 @@ class _Replica:
     router-side health ledger (consecutive failures, latency window)."""
 
     __slots__ = ("slot", "batcher", "state", "consec_failures", "lats",
-                 "served", "redispatched_away", "generation")
+                 "served", "redispatched_away", "generation", "tenant")
 
-    def __init__(self, slot, batcher, generation=0):
+    def __init__(self, slot, batcher, generation=0,
+                 tenant=DEFAULT_TENANT):
         self.slot = slot
         self.batcher = batcher
         self.state = STARTING
@@ -81,6 +108,7 @@ class _Replica:
         self.served = 0
         self.redispatched_away = 0
         self.generation = generation
+        self.tenant = tenant
 
     @property
     def predictor(self):
@@ -116,15 +144,39 @@ class FleetRouter:
     probe_interval_s / max_failures / straggler_factor /
     max_redispatch : optional
         Override the ``MXTPU_FLEET_*`` defaults (config.py).
+    tenants : list[TenantSpec], optional
+        Multi-tenant mode: each spec brings its own model factory,
+        replica count, SLO class, priority, and admission quota
+        (serving/tenancy.py); ``submit(tenant=name)`` routes within
+        that group. Without it the router is the one-tenant degenerate
+        case built from ``replica_factory``/``replicas``.
     """
 
-    def __init__(self, replica_factory, replicas=2, name="fleet",
+    def __init__(self, replica_factory=None, replicas=2, name="fleet",
                  probe_interval_s=None, max_failures=None,
-                 straggler_factor=None, max_redispatch=None):
-        if replicas < 1:
-            raise MXNetError("FleetRouter needs at least one replica")
-        self._factory = replica_factory
-        self._n = int(replicas)
+                 straggler_factor=None, max_redispatch=None,
+                 tenants=None):
+        if tenants:
+            specs = list(tenants)
+            for spec in specs:
+                if spec.factory is None:
+                    raise MXNetError(
+                        f"tenant '{spec.name}' has no replica factory")
+        else:
+            if replica_factory is None:
+                raise MXNetError(
+                    "FleetRouter needs replica_factory or tenants")
+            if replicas < 1:
+                raise MXNetError(
+                    "FleetRouter needs at least one replica")
+            specs = [TenantSpec(DEFAULT_TENANT, factory=replica_factory,
+                                replicas=int(replicas))]
+        self._tenants = {}
+        for spec in specs:
+            if spec.name in self._tenants:
+                raise MXNetError(f"duplicate tenant '{spec.name}'")
+            self._tenants[spec.name] = _TenantLedger(spec)
+        self._n = sum(s.replicas for s in specs)
         self.name = name
         self.probe_interval_s = float(
             probe_interval_s if probe_interval_s is not None
@@ -149,12 +201,20 @@ class FleetRouter:
         self._routed = 0
         self._served = 0
         self._redispatched = 0
+        self._parked = 0          # admitted requests parked for capacity
         self._shed = 0
         self._failed = 0
         self._drains = 0
         self._replaces = 0
         self._last_drain_s = None
         self._replacement_retraces = []   # fresh traces per replacement
+        # autoscale / hot-swap ledger (under _lock)
+        self._scale_ups = 0
+        self._scale_downs = 0
+        self._spinup_retraces = []        # fresh traces per scale_up
+        self._swaps = 0
+        self._last_swap_s = None
+        self._degrade_overload = False    # ladder rung 3: fleet closed
         _register_router(self)
         from ..telemetry import registry as treg
         fid = self.telemetry_id
@@ -162,6 +222,14 @@ class FleetRouter:
         self._c_redis = treg.counter(f"fleet::{fid}::redispatched")
         self._c_shed = treg.counter(f"fleet::{fid}::shed")
         self._g_shed_rate = treg.gauge("fleet::shed_rate")
+        self._c_scale_up = treg.counter(f"fleet::{fid}::scale_up")
+        self._c_scale_down = treg.counter(f"fleet::{fid}::scale_down")
+        # the tenant series are process-global by tenant name; drop
+        # them with the router so tenant churn cannot grow the registry
+        import weakref
+        for tname in self._tenants:
+            weakref.finalize(self, treg.remove,
+                             f"serving::tenant::{tname}::")
 
     # -- lifecycle ------------------------------------------------------------
     def start(self):
@@ -171,8 +239,11 @@ class FleetRouter:
         with self._lock:
             if self._running:
                 return self
-            for slot in range(self._n):
-                self._replicas.append(self._spawn(slot))
+            slot = 0
+            for tname, ledger in self._tenants.items():
+                for _ in range(ledger.spec.replicas):
+                    self._replicas.append(self._spawn(slot, tname))
+                    slot += 1
             self._running = True
         self._probe = threading.Thread(target=self._probe_loop,
                                        name=f"{self.name}-probe",
@@ -187,7 +258,7 @@ class FleetRouter:
             if not self._running:
                 return
             self._running = False
-            replicas = list(self._replicas)
+            replicas = [r for r in self._replicas if r is not None]
         if self._probe is not None:
             self._probe.join(timeout=self.probe_interval_s * 4 + 5)
             self._probe = None
@@ -204,60 +275,130 @@ class FleetRouter:
     def __exit__(self, *exc):
         self.stop()
 
-    def _spawn(self, slot):
-        """Factory + warmup for one replica slot (replacements reuse
-        this; the warmup retrace count is the AOT-spin-up pin)."""
-        batcher = self._factory()
+    def _spawn(self, slot, tenant=DEFAULT_TENANT):
+        """Factory + warmup for one replica slot (replacements and
+        scale-ups reuse this; the warmup retrace count is the
+        AOT-spin-up pin)."""
+        batcher = self._tenants[tenant].spec.factory()
         batcher.start()
-        rep = _Replica(slot, batcher, generation=self._gen)
+        rep = _Replica(slot, batcher, generation=self._gen,
+                       tenant=tenant)
         rep.state = HEALTHY
         return rep
 
+    def _live(self):
+        """Snapshot of occupied slots (scale-down leaves None holes)."""
+        with self._lock:
+            return [r for r in self._replicas if r is not None]
+
+    def _resolve_tenant(self, tenant):
+        if tenant is None:
+            if len(self._tenants) == 1:
+                return next(iter(self._tenants))
+            if DEFAULT_TENANT in self._tenants:
+                return DEFAULT_TENANT
+            raise MXNetError(
+                f"fleet '{self.name}' is multi-tenant "
+                f"({sorted(self._tenants)}): submit(tenant=...) is "
+                "required")
+        if tenant not in self._tenants:
+            raise MXNetError(
+                f"fleet '{self.name}': unknown tenant '{tenant}' "
+                f"(have {sorted(self._tenants)})")
+        return tenant
+
+    def _retire(self, rep):
+        """Eagerly drop a retired replica's ``serving::<id>::``
+        registry series. The weakref finalizer in serving/__init__
+        still backstops this at GC, but autoscale churn (20 cycles =
+        20 dead predictors) must not grow ``mx.telemetry.report()``
+        until the collector happens to run."""
+        from ..telemetry import registry as treg
+        try:
+            treg.remove(f"serving::{rep.predictor.telemetry_id}::")
+        except Exception:                # noqa: BLE001
+            pass
+
     # -- client surface -------------------------------------------------------
-    def submit(self, data, deadline_ms=None, **kw):
-        """Route one request to the least-loaded healthy replica;
-        returns the future (a ``ServingFuture``, or the replica's
-        ``StreamFuture`` for decode fleets). Raises fleet-level
-        ``Overloaded`` only when EVERY healthy replica sheds."""
+    def submit(self, data, deadline_ms=None, tenant=None, **kw):
+        """Route one request to the least-loaded healthy replica of
+        its tenant's group; returns the future (a ``ServingFuture``,
+        or the replica's ``StreamFuture`` for decode fleets). Raises
+        fleet-level ``Overloaded`` when EVERY healthy replica sheds,
+        when the tenant's weighted-fair in-flight quota is full, or
+        when the degradation ladder has closed admission."""
         deadline = time.perf_counter() + deadline_ms / 1e3 \
             if deadline_ms is not None else None
+        tname = self._resolve_tenant(tenant)
+        ledger = self._tenants[tname]
         with self._lock:
             if not self._running:
                 raise MXNetError(f"FleetRouter '{self.name}' is not "
                                  "started")
             self._routed += 1
+            ledger.routed += 1
+            degraded = self._degrade_overload or ledger.degraded_shed
+            quota_full = ledger.inflight >= ledger.spec.quota
         self._c_routed.inc()
+        if degraded:
+            self._note_shed(ledger)
+            raise Overloaded(
+                f"fleet '{self.name}': degraded — admission closed for "
+                f"tenant '{tname}' (overloaded at max scale); retry "
+                "with backoff")
+        if faultinject.fire("tenant_admit", tenant=tname):
+            self._note_shed(ledger)
+            raise Overloaded(
+                f"fleet '{self.name}': tenant '{tname}' admission "
+                "fault injected; shedding")
+        if quota_full:
+            self._note_shed(ledger)
+            raise Overloaded(
+                f"fleet '{self.name}': tenant '{tname}' is at its "
+                f"in-flight quota ({ledger.spec.quota}); shedding — "
+                "retry with backoff")
+        # count the request against the quota BEFORE dispatch: a fast
+        # replica may complete (and _finish may decrement) before
+        # submit returns
+        with self._lock:
+            ledger.inflight += 1
         fut = self._dispatch(data, deadline, deadline_ms, kw, attempt=0,
-                             outer=None, t0=time.perf_counter())
+                             outer=None, t0=time.perf_counter(),
+                             ledger=ledger)
         if fut is None:
-            self._note_shed()
+            with self._lock:
+                ledger.inflight -= 1
+            self._note_shed(ledger)
             raise Overloaded(
                 f"fleet '{self.name}': every healthy replica is at its "
                 "queue bound; shedding — retry with backoff")
         return fut
 
-    def predict(self, data, deadline_ms=None, timeout=None, **kw):
+    def predict(self, data, deadline_ms=None, timeout=None, tenant=None,
+                **kw):
         """Blocking convenience: ``submit(...).result(...)``."""
         return self.submit(data, deadline_ms=deadline_ms,
-                           **kw).result(timeout)
+                           tenant=tenant, **kw).result(timeout)
 
     # -- dispatch / re-dispatch ----------------------------------------------
-    def _candidates(self):
+    def _candidates(self, tenant=None):
         with self._lock:
-            reps = [r for r in self._replicas if r.state == HEALTHY]
+            reps = [r for r in self._replicas
+                    if r is not None and r.state == HEALTHY
+                    and (tenant is None or r.tenant == tenant)]
         return sorted(reps, key=lambda r: r.queue_depth())
 
     def _dispatch(self, data, deadline, deadline_ms, kw, attempt, outer,
-                  t0):
-        """Try healthy replicas in least-loaded order. Returns the
-        client-facing future, or None when every replica shed (the
-        caller decides between fleet Overloaded and completing
-        ``outer``)."""
+                  t0, ledger):
+        """Try the tenant's healthy replicas in least-loaded order.
+        Returns the client-facing future, or None when every replica
+        shed (the caller decides between fleet Overloaded and
+        completing ``outer``)."""
         remaining_ms = deadline_ms
         if deadline is not None:
             remaining_ms = max(0.0,
                                (deadline - time.perf_counter()) * 1e3)
-        for rep in self._candidates():
+        for rep in self._candidates(ledger.spec.name):
             try:
                 inner = rep.batcher.submit(data,
                                            deadline_ms=remaining_ms,
@@ -284,12 +425,12 @@ class FleetRouter:
             inner.add_done_callback(
                 lambda f, rep=rep: self._on_done(
                     rep, f, outer, data, deadline, deadline_ms, kw,
-                    attempt, t0))
+                    attempt, t0, ledger))
             return outer
         return None
 
     def _on_done(self, rep, inner, outer, data, deadline, deadline_ms,
-                 kw, attempt, t0):
+                 kw, attempt, t0, ledger):
         """Completion handler for one replica-level future: surface the
         result, or classify the error and transparently re-dispatch."""
         err = inner._error
@@ -302,11 +443,12 @@ class FleetRouter:
                 if len(rep.lats) > self._lat_window:
                     del rep.lats[:len(rep.lats) - self._lat_window]
                 self._served += 1
-            self._finish(outer, result=inner._result, t0=t0)
+            self._finish(outer, result=inner._result, t0=t0,
+                         ledger=ledger)
             return
         if isinstance(err, DeadlineExceeded):
             # the REQUEST ran out of budget, not the replica
-            self._finish(outer, error=err, t0=t0)
+            self._finish(outer, error=err, t0=t0, ledger=ledger)
             return
         redispatchable = True
         if isinstance(err, Overloaded):
@@ -322,20 +464,66 @@ class FleetRouter:
             self._c_redis.inc()
             self._emit_redispatch(rep, outer, attempt, err)
             fut = self._dispatch(data, deadline, deadline_ms, kw,
-                                 attempt + 1, outer, t0)
+                                 attempt + 1, outer, t0, ledger)
             if fut is not None:
+                return
+            if self._park_redispatch(data, deadline, deadline_ms, kw,
+                                     attempt + 1, outer, t0, ledger):
                 return
             self._note_shed()
             err = Overloaded(
                 f"fleet '{self.name}': no healthy replica to "
                 f"re-dispatch to after {type(err).__name__}")
-        self._finish(outer, error=err, t0=t0)
+        self._finish(outer, error=err, t0=t0, ledger=ledger)
+
+    def _park_redispatch(self, data, deadline, deadline_ms, kw, attempt,
+                         outer, t0, ledger):
+        """No healthy replica at re-dispatch time — but the request was
+        ADMITTED, and capacity is coming (a STARTING spin-up, or the
+        probe loop replacing the condemned replica). Park the request
+        on a timer and keep retrying until a replica takes it, instead
+        of dropping an admitted request on a transient zero-capacity
+        window (the autoscale chaos drill pins zero such drops). Gives
+        up at the request deadline, or after
+        ``MXTPU_FLEET_REDISPATCH_GRACE_S`` when there is none."""
+        grace = deadline if deadline is not None else \
+            t0 + float(config.get("MXTPU_FLEET_REDISPATCH_GRACE_S", 5.0))
+        if not self._running or time.perf_counter() >= grace:
+            return False
+        with self._lock:
+            self._parked += 1
+
+        def _retry():
+            if self._running:
+                fut = self._dispatch(data, deadline, deadline_ms, kw,
+                                     attempt, outer, t0, ledger)
+                if fut is not None:
+                    return
+                if time.perf_counter() < grace:
+                    again = threading.Timer(0.02, _retry)
+                    again.daemon = True
+                    again.start()
+                    return
+            self._note_shed()
+            self._finish(outer, error=Overloaded(
+                f"fleet '{self.name}': no healthy replica within the "
+                "re-dispatch grace; shedding — retry with backoff"),
+                t0=t0, ledger=ledger)
+
+        timer = threading.Timer(0.02, _retry)
+        timer.daemon = True
+        timer.start()
+        return True
 
     def _note_stream_done(self, rep, fut, t0):
         err = fut._error
         from . import Cancelled
         now = time.perf_counter()
+        ledger = self._tenants.get(rep.tenant)
         with self._lock:
+            if ledger is not None:
+                ledger.inflight -= 1
+                ledger.note_done(now - t0, err, self._lat_window)
             if err is None:
                 rep.consec_failures = 0
                 rep.served += 1
@@ -361,7 +549,14 @@ class FleetRouter:
                 rep.state = DEAD
         return True
 
-    def _finish(self, outer, result=None, error=None, t0=None):
+    def _finish(self, outer, result=None, error=None, t0=None,
+                ledger=None):
+        if ledger is not None:
+            now = time.perf_counter()
+            with self._lock:
+                ledger.inflight -= 1
+                ledger.note_done(now - (t0 if t0 is not None else now),
+                                 error, self._lat_window)
         if outer is None:
             return
         outer._complete(result=result, error=error)
@@ -372,10 +567,12 @@ class FleetRouter:
                 args={"router": self.telemetry_id,
                       "error": type(error).__name__ if error else None})
 
-    def _note_shed(self):
+    def _note_shed(self, ledger=None):
         with self._lock:
             self._shed += 1
             shed, routed = self._shed, self._routed
+            if ledger is not None:
+                ledger.note_shed()
         self._c_shed.inc()
         self._g_shed_rate.set(shed / max(1, routed))
         from ..telemetry import export as _texp
@@ -417,8 +614,7 @@ class FleetRouter:
     def _probe_once(self):
         """One health pass: condemn faulted replicas, drain the worst
         straggler, replace the dead."""
-        with self._lock:
-            reps = list(self._replicas)
+        reps = self._live()
         for rep in reps:
             if rep.state == HEALTHY and \
                     getattr(rep.predictor, "_faulted", False):
@@ -434,21 +630,25 @@ class FleetRouter:
                 self._replace(rep)
 
     def _find_straggler(self):
-        with self._lock:
-            healthy = [r for r in self._replicas
-                       if r.state == HEALTHY
-                       and len(r.lats) >= self._min_lat_samples]
-            if len(healthy) < 2:
-                return None
-            meds = {r: _median(r.lats) for r in healthy}
-        fleet_med = _median(list(meds.values()))
-        if not fleet_med:
-            return None
-        worst = max(meds, key=meds.get)
-        if meds[worst] >= self.straggler_factor * fleet_med:
+        """Worst straggler across tenant groups (latency compares
+        within a group: two models are allowed different speeds)."""
+        for tname in self._tenants:
             with self._lock:
-                worst.state = DRAINING
-            return worst
+                healthy = [r for r in self._replicas
+                           if r is not None and r.state == HEALTHY
+                           and r.tenant == tname
+                           and len(r.lats) >= self._min_lat_samples]
+                if len(healthy) < 2:
+                    continue
+                meds = {r: _median(r.lats) for r in healthy}
+            fleet_med = _median(list(meds.values()))
+            if not fleet_med:
+                continue
+            worst = max(meds, key=meds.get)
+            if meds[worst] >= self.straggler_factor * fleet_med:
+                with self._lock:
+                    worst.state = DRAINING
+                return worst
         return None
 
     def _drain(self, rep, polite):
@@ -488,7 +688,7 @@ class FleetRouter:
             self._gen += 1
             gen = self._gen
         try:
-            fresh = self._spawn(rep.slot)
+            fresh = self._spawn(rep.slot, rep.tenant)
         except Exception:                # noqa: BLE001 — retry next probe
             import logging
             logging.getLogger("mxnet_tpu.serving").exception(
@@ -499,6 +699,7 @@ class FleetRouter:
             self._replicas[rep.slot] = fresh
             self._replaces += 1
             self._replacement_retraces.append(fresh.predictor.retraces)
+        self._retire(rep)
         from ..telemetry import export as _texp
         if _texp.enabled():
             _texp.emit_event(
@@ -515,12 +716,158 @@ class FleetRouter:
         Returns the drain latency in seconds."""
         with self._lock:
             rep = self._replicas[slot]
+            if rep is None:
+                raise MXNetError(f"fleet slot {slot} is vacant")
             if rep.state != HEALTHY:
                 raise MXNetError(
                     f"fleet slot {slot} is {rep.state}, not healthy")
             rep.state = DRAINING
         self._drain(rep, polite=True)
         return self._last_drain_s
+
+    # -- elastic slots (serving/autoscale.py drives these) --------------------
+    def scale_up(self, tenant=None):
+        """Spin one more replica into ``tenant``'s group (a vacant
+        slot is reused, else the fleet grows a slot). The spin-up is
+        an AOT load from the shared compile cache — the fresh-trace
+        count is recorded in ``spinup_retraces`` and pinned at 0 by
+        the drills. The ``scale_up`` fault site fires before the
+        factory runs (the failed/hung-provision drill); a raise leaves
+        the slot vacant for the autoscaler's backoff retry. Returns
+        the new slot index."""
+        tname = self._resolve_tenant(tenant)
+        with self._lock:
+            if not self._running:
+                raise MXNetError(f"FleetRouter '{self.name}' is not "
+                                 "started")
+            slot = next((i for i, r in enumerate(self._replicas)
+                         if r is None), None)
+            if slot is None:
+                slot = len(self._replicas)
+                self._replicas.append(None)
+            self._gen += 1
+            gen = self._gen
+        params = faultinject.active("scale_up")
+        if faultinject.fire("scale_up", tenant=tname) and \
+                (params or {}).get("action") != "sleep":
+            raise faultinject.FaultInjected("scale_up", tenant=tname)
+        fresh = self._spawn(slot, tname)
+        fresh.generation = gen
+        with self._lock:
+            self._replicas[slot] = fresh
+            self._scale_ups += 1
+            self._spinup_retraces.append(fresh.predictor.retraces)
+        self._c_scale_up.inc()
+        from ..telemetry import export as _texp
+        if _texp.enabled():
+            _texp.emit_event(
+                "fleet_scale_up", router=self.telemetry_id, slot=slot,
+                tenant=tname, replica=fresh.predictor.telemetry_id,
+                retraces=fresh.predictor.retraces,
+                cache_loads=fresh.predictor._cache_loads)
+        return slot
+
+    def scale_down(self, slot=None, tenant=None):
+        """Retire one replica through the polite DRAINING path: the
+        slot is vacated FIRST (no new dispatches; the probe loop will
+        not resurrect it), queued work is served out, then the dead
+        replica's registry series are dropped eagerly. ``slot=None``
+        picks the least-loaded healthy replica of ``tenant``. Refuses
+        to retire a tenant's last healthy replica. Returns the vacated
+        slot index, or None when nothing was eligible."""
+        tname = self._resolve_tenant(tenant)
+        with self._lock:
+            healthy = [r for r in self._replicas
+                       if r is not None and r.state == HEALTHY
+                       and r.tenant == tname]
+            if len(healthy) <= 1:
+                return None
+            if slot is None:
+                rep = min(healthy, key=lambda r: r.queue_depth())
+            else:
+                rep = self._replicas[slot]
+                if rep is None or rep.state != HEALTHY or \
+                        rep.tenant != tname:
+                    return None
+            rep.state = DRAINING
+            self._replicas[rep.slot] = None   # vacate: no replacement
+            self._scale_downs += 1
+        self._drain(rep, polite=True)
+        self._retire(rep)
+        self._c_scale_down.inc()
+        from ..telemetry import export as _texp
+        if _texp.enabled():
+            _texp.emit_event(
+                "fleet_scale_down", router=self.telemetry_id,
+                slot=rep.slot, tenant=tname,
+                replica=rep.predictor.telemetry_id,
+                drain_s=self._last_drain_s)
+        return rep.slot
+
+    # -- weight hot-swap -------------------------------------------------------
+    def swap_weights(self, tenant=None, arg_params=None,
+                     aux_params=None, module=None, timeout_s=60.0):
+        """Stage a new checkpoint's params into ``tenant``'s replicas,
+        one replica at a time, with zero dropped requests and zero
+        recompiles.
+
+        Per replica: it stops taking new work (DRAINING — its
+        siblings keep serving), serves out its queue, restages the new
+        params as program *arguments* under the predictor lock
+        (``Predictor.restage``: the compile key covers shapes/dtypes/
+        passes only, so the cached executables run unchanged), then
+        rejoins HEALTHY. A single-replica group restages live instead
+        of draining (marking the only replica DRAINING would shed —
+        the opposite of zero-downtime); per-micro-batch atomicity
+        still holds via the predictor lock.
+
+        Pass ``arg_params``/``aux_params`` dicts (e.g. from
+        ``mx.model.load_checkpoint``) or ``module`` to pull them from
+        a trained Module. Returns the number of replicas swapped; the
+        result is pinned bit-identical to a fleet freshly started on
+        the new checkpoint."""
+        tname = self._resolve_tenant(tenant)
+        if module is not None:
+            arg_params, aux_params = module.get_params()
+        if not arg_params:
+            raise MXNetError("swap_weights needs arg_params or module")
+        t_start = time.perf_counter()
+        swapped = 0
+        for rep in self._live():
+            with self._lock:
+                if rep.tenant != tname or rep.state != HEALTHY or \
+                        self._replicas[rep.slot] is not rep:
+                    continue
+                siblings = any(
+                    r is not None and r is not rep
+                    and r.state == HEALTHY and r.tenant == tname
+                    for r in self._replicas)
+                if siblings:
+                    rep.state = DRAINING
+            try:
+                if siblings:
+                    deadline = time.monotonic() + timeout_s
+                    while rep.queue_depth() > 0 and \
+                            time.monotonic() < deadline:
+                        time.sleep(0.002)
+                rep.predictor.restage(arg_params, aux_params)
+            finally:
+                with self._lock:
+                    if rep.state == DRAINING:
+                        rep.state = HEALTHY
+            swapped += 1
+            from ..telemetry import export as _texp
+            if _texp.enabled():
+                _texp.emit_event(
+                    "fleet_swap_replica", router=self.telemetry_id,
+                    slot=rep.slot, tenant=tname,
+                    replica=rep.predictor.telemetry_id,
+                    retraces=rep.predictor.retraces)
+        with self._lock:
+            self._swaps += 1
+            self._last_swap_s = time.perf_counter() - t_start
+            self._tenants[tname].swaps += 1
+        return swapped
 
     # -- observability --------------------------------------------------------
     @property
@@ -530,16 +877,54 @@ class FleetRouter:
 
     def replica_states(self):
         with self._lock:
-            return {r.slot: r.state for r in self._replicas}
+            return {r.slot: r.state for r in self._replicas
+                    if r is not None}
+
+    def healthy_count(self, tenant=None):
+        """Healthy replicas in ``tenant``'s group (all groups when
+        None)."""
+        tname = None if tenant is None and len(self._tenants) > 1 \
+            else self._resolve_tenant(tenant)
+        with self._lock:
+            return sum(1 for r in self._replicas
+                       if r is not None and r.state == HEALTHY
+                       and (tname is None or r.tenant == tname))
+
+    def signals(self, tenant=None):
+        """The autoscaler's per-tenant-group input: healthy replica
+        count, queued rows, total micro-batch capacity, in-flight
+        requests, and the tenant shed counter (the caller diffs it
+        across ticks)."""
+        tname = self._resolve_tenant(tenant)
+        ledger = self._tenants[tname]
+        with self._lock:
+            reps = [r for r in self._replicas
+                    if r is not None and r.state == HEALTHY
+                    and r.tenant == tname]
+            inflight = ledger.inflight
+            shed = ledger.shed
+        queued = sum(r.queue_depth() for r in reps)
+        capacity = sum(getattr(r.batcher, "max_batch", 1) for r in reps)
+        return {"tenant": tname, "healthy": len(reps),
+                "queued_rows": queued, "capacity": max(1, capacity),
+                "inflight": inflight, "shed": shed}
+
+    def tenant_report(self, reset=False):
+        with self._lock:
+            return {name: ledger.report(reset=reset)
+                    for name, ledger in self._tenants.items()}
 
     def report(self, reset=False):
         with self._lock:
             per_replica = []
             for r in self._replicas:
+                if r is None:
+                    continue
                 med = _median(r.lats)
                 per_replica.append({
                     "slot": r.slot,
                     "id": r.predictor.telemetry_id,
+                    "tenant": r.tenant,
                     "state": r.state,
                     "generation": r.generation,
                     "served": r.served,
@@ -556,6 +941,7 @@ class FleetRouter:
                 "routed": self._routed,
                 "served": self._served,
                 "redispatched": self._redispatched,
+                "parked": self._parked,
                 "shed": self._shed,
                 "failed": self._failed,
                 "shed_rate": self._shed / max(1, self._routed),
@@ -563,10 +949,22 @@ class FleetRouter:
                 "replaces": self._replaces,
                 "last_drain_s": self._last_drain_s,
                 "replacement_retraces": list(self._replacement_retraces),
+                "scale_ups": self._scale_ups,
+                "scale_downs": self._scale_downs,
+                "spinup_retraces": list(self._spinup_retraces),
+                "swaps": self._swaps,
+                "last_swap_s": self._last_swap_s,
+                "degrade_overload": self._degrade_overload,
+                "tenants": {name: ledger.report(reset=reset)
+                            for name, ledger in self._tenants.items()},
             }
             if reset:
                 self._routed = self._served = 0
-                self._redispatched = self._shed = self._failed = 0
+                self._redispatched = self._parked = 0
+                self._shed = self._failed = 0
                 self._drains = self._replaces = 0
                 self._replacement_retraces = []
+                self._scale_ups = self._scale_downs = 0
+                self._spinup_retraces = []
+                self._swaps = 0
         return out

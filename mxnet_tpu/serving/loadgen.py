@@ -30,8 +30,8 @@ import numpy as np
 
 from . import Overloaded
 
-__all__ = ["closed_loop", "raw_predict_rate", "token_closed_loop",
-           "client_report"]
+__all__ = ["closed_loop", "ramp", "raw_predict_rate",
+           "token_closed_loop", "client_report"]
 
 # client-side retry ledger (process-wide; serving_report()'s "clients"
 # section reads it, reset=True starts a fresh window)
@@ -155,6 +155,166 @@ def closed_loop(batcher, x_req, clients, per_client, timeout=300,
         "submitted": n_reqs,
         "completed": n_ok,
         "gave_up": failed[0],
+    }
+
+
+def _expand_profile(profile):
+    """Expand a ramp profile dict into ``[(duration_s, clients), ...]``
+    steps.
+
+    ``{"shape": "step", "steps": [(dur_s, clients), ...]}`` is taken
+    verbatim; ``{"shape": "sine", "period_s": P, "min_clients": lo,
+    "max_clients": hi, "duration_s": D, "step_s": S}`` samples a raised
+    cosine (starting at ``lo``) every ``S`` seconds — the diurnal-ish
+    traffic wave the autoscaler drills ride."""
+    shape = profile.get("shape", "step")
+    if shape == "step":
+        steps = [(float(d), int(c)) for d, c in profile["steps"]]
+    elif shape == "sine":
+        import math
+        period = float(profile["period_s"])
+        lo = int(profile["min_clients"])
+        hi = int(profile["max_clients"])
+        dur = float(profile.get("duration_s", period))
+        step_s = float(profile.get("step_s", period / 8.0))
+        steps = []
+        t = 0.0
+        while t < dur:
+            frac = 0.5 - 0.5 * math.cos(2.0 * math.pi * t / period)
+            steps.append((min(step_s, dur - t),
+                          max(0, int(round(lo + (hi - lo) * frac)))))
+            t += step_s
+    else:
+        raise ValueError(f"unknown ramp profile shape {shape!r}")
+    if not steps:
+        raise ValueError("ramp profile expands to zero steps")
+    return steps
+
+
+def ramp(batcher, x_req, profile, tenants=None, timeout=300,
+         deadline_ms=None, retries=0, backoff_ms=25, jitter=0.5):
+    """Closed-loop load with a TIME-VARYING client count — the traffic
+    ramp the autoscaler drills (and ``bench.py fleet_autoscale``) drive
+    against a FleetRouter.
+
+    ``profile`` is expanded by :func:`_expand_profile` (stepped or
+    sine). A pool of ``max(clients)`` worker threads runs for the whole
+    profile; only the first ``clients``-of-the-current-step workers
+    submit, the rest idle — stepping the active count up and down
+    without thread churn. ``tenants`` (``{name: weight}``) turns each
+    worker into a deterministic weighted wheel over tenant names, so a
+    70/30 latency/batch mix is exactly 70/30, not a coin flip.
+
+    The same ``retries``/``backoff_ms``/``jitter`` Overloaded-retry
+    policy as :func:`closed_loop` applies per request. Returns overall,
+    per-step, and per-tenant stats; a request that exhausted its retry
+    budget counts in ``gave_up`` (and per-tenant ``gave_up``), never in
+    the latency percentiles."""
+    steps = _expand_profile(profile)
+    max_clients = max(c for _, c in steps)
+    if max_clients < 1:
+        raise ValueError("ramp profile never activates a client")
+    wheel = []
+    if tenants:
+        for tname, weight in tenants.items():
+            wheel.extend([tname] * max(1, int(weight)))
+    rows = x_req.shape[0] if hasattr(x_req, "shape") else 1
+    stop = threading.Event()
+    target = [0]
+    step_idx = [0]
+    lock = threading.Lock()
+    recs = []                      # (t_rel, lat_s, tenant, step_idx)
+    counts = {"submitted": 0, "gave_up": 0}
+    by_tenant = {t: {"submitted": 0, "gave_up": 0, "lats": []}
+                 for t in (tenants or {})}
+    t0 = time.perf_counter()
+
+    def worker(idx):
+        k = 0
+        while not stop.is_set():
+            if idx >= target[0]:
+                time.sleep(0.002)
+                continue
+            tname = wheel[(idx + k) % len(wheel)] if wheel else None
+            k += 1
+            kw = {}
+            if deadline_ms is not None:
+                kw["deadline_ms"] = deadline_ms
+            if tname is not None:
+                kw["tenant"] = tname
+            si = step_idx[0]
+            t_r = time.perf_counter()
+            deadline = t_r + deadline_ms / 1e3 \
+                if deadline_ms is not None else None
+            with lock:
+                counts["submitted"] += 1
+                if tname is not None:
+                    by_tenant[tname]["submitted"] += 1
+            try:
+                _call_with_retry(
+                    lambda: batcher.predict(x_req, timeout=timeout,
+                                            **kw),
+                    deadline, retries, backoff_ms, jitter)
+            except Overloaded:
+                with lock:
+                    counts["gave_up"] += 1
+                    if tname is not None:
+                        by_tenant[tname]["gave_up"] += 1
+                continue
+            lat = time.perf_counter() - t_r
+            with lock:
+                recs.append((t_r - t0, lat, tname, si))
+                if tname is not None:
+                    by_tenant[tname]["lats"].append(lat)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(max_clients)]
+    for t in threads:
+        t.start()
+    for i, (dur, c) in enumerate(steps):
+        step_idx[0] = i
+        target[0] = c
+        time.sleep(dur)
+    stop.set()
+    target[0] = 0
+    for t in threads:
+        t.join(timeout=timeout)
+    wall = time.perf_counter() - t0
+
+    def _pct(xs, q):
+        return float(np.percentile(xs, q)) * 1e3 if xs else None
+
+    phases = []
+    for i, (dur, c) in enumerate(steps):
+        lats = [lat for _, lat, _, si in recs if si == i]
+        phases.append({
+            "clients": c, "duration_s": dur, "completed": len(lats),
+            "req_s": len(lats) / dur if dur > 0 else None,
+            "p50_ms": _pct(lats, 50), "p99_ms": _pct(lats, 99),
+        })
+    tenant_stats = {}
+    for tname, d in by_tenant.items():
+        tenant_stats[tname] = {
+            "submitted": d["submitted"],
+            "completed": len(d["lats"]),
+            "gave_up": d["gave_up"],
+            "p50_ms": _pct(d["lats"], 50),
+            "p99_ms": _pct(d["lats"], 99),
+        }
+    all_lats = [lat for _, lat, _, _ in recs]
+    return {
+        "wall_s": wall,
+        "max_clients": max_clients,
+        "steps": [[d, c] for d, c in steps],
+        "submitted": counts["submitted"],
+        "completed": len(all_lats),
+        "gave_up": counts["gave_up"],
+        "req_s": len(all_lats) / wall if wall > 0 else None,
+        "rows_s": len(all_lats) * rows / wall if wall > 0 else None,
+        "p50_ms": _pct(all_lats, 50),
+        "p99_ms": _pct(all_lats, 99),
+        "phases": phases,
+        "tenants": tenant_stats,
     }
 
 

@@ -145,18 +145,11 @@ class Predictor:
             if n not in aux_params:
                 raise MXNetError(f"Predictor missing aux state '{n}'")
 
-        def _stage(v, want_shape, name):
-            a = np.asarray(getattr(v, "_data", getattr(v, "data", v)))
-            if tuple(a.shape) != tuple(want_shape):
-                raise MXNetError(
-                    f"Predictor param '{name}' has shape {a.shape}, "
-                    f"inferred {tuple(want_shape)}")
-            x = jnp.asarray(a)
-            if self._cdt is not None and x.dtype == jnp.float32:
-                x = x.astype(self._cdt)
-            return jax.device_put(x)
-
-        self._pvals = {n: _stage(arg_params[n], arg_shape_map[n], n)
+        self._arg_shape_map = arg_shape_map
+        self._aux_shape_map = aux_shape_map
+        self._aux_names = aux_names
+        self._pvals = {n: self._stage_value(arg_params[n],
+                                            arg_shape_map[n], n)
                        for n in self.param_names
                        if n not in self._zero_args}
 
@@ -209,7 +202,8 @@ class Predictor:
         # program's byte traffic, not just its op count.
         hoist_keys, live_vars = _hoist.hoist_plan(
             run_sym, set(self.data_names) | zero_args)
-        staged_aux = {n: _stage(aux_params[n], aux_shape_map[n], n)
+        staged_aux = {n: self._stage_value(aux_params[n],
+                                           aux_shape_map[n], n)
                       for n in aux_names}
         if hoist_keys:
             amap = dict(self._pvals)
@@ -234,6 +228,12 @@ class Predictor:
         pval_names = list(self._pval_names)
         live_aux_names = [n for n in run_aux_names if n in live_vars]
         self._avals = tuple(staged_aux[n] for n in live_aux_names)
+        # restage() needs the staging plan after __init__: which symbol
+        # actually runs, which param expressions were hoisted, and
+        # which aux names the program consumes
+        self._run_sym = run_sym
+        self._hoist_keys = hoist_keys
+        self._live_aux_names = live_aux_names
 
         def infer_fn(pvals_t, data_vals, avals, hvals):
             amap = dict(zip(pval_names, pvals_t))
@@ -287,6 +287,69 @@ class Predictor:
         kwargs.setdefault("data_shapes", {
             n: tuple(s[1:]) for n, s in module.data_shapes})
         return cls(module.symbol, arg_params, aux_params, **kwargs)
+
+    # -- parameter staging ----------------------------------------------------
+    def _stage_value(self, v, want_shape, name):
+        """Shape-check one param/aux value and put it on device (cast
+        to the compute dtype when configured) — the single staging rule
+        __init__ and restage share."""
+        import jax
+        import jax.numpy as jnp
+        a = np.asarray(getattr(v, "_data", getattr(v, "data", v)))
+        if tuple(a.shape) != tuple(want_shape):
+            raise MXNetError(
+                f"Predictor param '{name}' has shape {a.shape}, "
+                f"inferred {tuple(want_shape)}")
+        x = jnp.asarray(a)
+        if self._cdt is not None and x.dtype == jnp.float32:
+            x = x.astype(self._cdt)
+        return jax.device_put(x)
+
+    def restage(self, arg_params, aux_params=None):
+        """Swap in a new checkpoint's parameter values WITHOUT touching
+        the compiled programs (the weight-hot-swap primitive,
+        ``FleetRouter.swap_weights`` drives it replica-by-replica).
+
+        Parameters are program *arguments* — the program key covers
+        shapes/dtypes/passes, never values — so staging new values and
+        recomputing the hoisted parameter expressions is the complete
+        swap: zero retraces, and the next micro-batch computes exactly
+        what a fresh Predictor on the new checkpoint would. Staging and
+        hoist evaluation happen OUTSIDE the run lock; the final pointer
+        swap takes it, so an in-flight micro-batch finishes on the old
+        weights and the swap is atomic per micro-batch."""
+        import jax
+        aux_params = aux_params or {}
+        missing = [n for n in self.param_names
+                   if n not in self._zero_args and n not in arg_params]
+        if missing:
+            raise MXNetError(f"restage missing parameters {missing}")
+        for n in self._aux_names:
+            if n not in aux_params:
+                raise MXNetError(f"restage missing aux state '{n}'")
+        new_pvals = {n: self._stage_value(arg_params[n],
+                                          self._arg_shape_map[n], n)
+                     for n in self.param_names
+                     if n not in self._zero_args}
+        new_aux = {n: self._stage_value(aux_params[n],
+                                        self._aux_shape_map[n], n)
+                   for n in self._aux_names}
+        if self._hoist_keys:
+            from ..symbol.passes import hoist as _hoist
+            amap = dict(new_pvals)
+            amap.update(new_aux)
+            new_hvals = tuple(
+                jax.device_put(v) for v in _hoist.hoist_values(
+                    self._run_sym, self._hoist_keys, amap))
+        else:
+            new_hvals = ()
+        with self._lock:
+            self._pvals = new_pvals
+            self._pvals_t = tuple(new_pvals[n]
+                                  for n in self._pval_names)
+            self._avals = tuple(new_aux[n]
+                                for n in self._live_aux_names)
+            self._hvals = new_hvals
 
     # -- bucketing ------------------------------------------------------------
     @property

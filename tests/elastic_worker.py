@@ -71,6 +71,14 @@ def main():
     r, w = dist.process_identity()
     assert (r, w) == (rank, world), (r, w, rank, world)
 
+    # machine-check the multi-host supervisor handshake (round 20):
+    # under a HostSupervisor an env that disagrees with this host's
+    # published rank file must fail fast HERE, before touching the mesh
+    ident = elastic.SupervisorSpec.check_env()
+    if ident is not None:
+        print(f"rank {rank}: supervisor handshake ok ({ident})",
+              flush=True)
+
     # fixed-seed GLOBAL dataset, deterministically sharded per rank —
     # a re-formed generation recomputes its shard from (rank, world)
     rng = np.random.RandomState(42)

@@ -1,0 +1,695 @@
+"""Round-20 fleet drills: queue-driven autoscaling, multi-tenant SLO
+isolation, weight hot-swap, the degradation ladder, and the multi-host
+supervisor contract.
+
+Every case is deterministic: the policy logic runs on a scripted
+router + synthetic clock (no sleeps, no load-timing races), and the
+live drills pin structural invariants — zero dropped admitted
+requests, zero fresh XLA traces on spin-up, bit-identical outputs
+after a hot-swap — rather than wall-clock numbers. The one latency pin
+(two-tenant isolation) compares against a solo baseline measured in
+the same process with a floor that absorbs CPU scheduling noise.
+"""
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faultinject, serving
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel.elastic import HostSupervisor, SupervisorSpec
+from mxnet_tpu.serving import (FleetAutoscaler, Overloaded, TenantSpec,
+                               loadgen)
+from mxnet_tpu.telemetry import registry as treg
+
+pytestmark = [pytest.mark.chaos, pytest.mark.serving]
+
+_FEAT = 16
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+_ELASTIC_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "elastic_worker.py")
+
+
+# -- fixtures -----------------------------------------------------------------
+
+def _make_module(prefix, seed=7):
+    mx.random.seed(seed)
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=32, name=f"{prefix}_fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name=f"{prefix}_relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=10, name=f"{prefix}_fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    mod = mx.mod.Module(context=mx.cpu(), symbol=net)
+    mod.bind(data_shapes=[("data", (8, _FEAT))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+    return mod
+
+
+def _factory_for(mod, name, **batcher_kw):
+    kw = {"max_wait_us": 1000, "max_queue": 4096}
+    kw.update(batcher_kw)
+
+    def factory():
+        pred = mod.as_predictor(buckets=(2, 8))
+        return serving.DynamicBatcher(pred, name=name, **kw)
+
+    return factory
+
+
+def _x(seed=0, rows=2):
+    return np.random.RandomState(seed).rand(rows, _FEAT) \
+        .astype(np.float32)
+
+
+@pytest.fixture()
+def ccache(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR",
+                       str(tmp_path / "ccache"))
+    yield
+    faultinject.reset()
+
+
+# -- scripted router: the policy logic on a synthetic clock -------------------
+
+class _FakeLedger:
+    def __init__(self, spec):
+        self.spec = spec
+        self.degraded_shed = False
+
+
+class _FakeBatcher:
+    def __init__(self):
+        self.max_wait_us = 1000
+        self.max_batch = 8
+
+
+class _FakeRouter:
+    """Just enough router surface for FleetAutoscaler: signals, the
+    scale verbs, the tenant ledgers, and the ladder's attachment
+    points."""
+    _seq = 0
+
+    def __init__(self, specs):
+        _FakeRouter._seq += 1
+        self.telemetry_id = f"fakefleet{_FakeRouter._seq}"
+        self._lock = threading.Lock()
+        self._tenants = {s.name: _FakeLedger(s) for s in specs}
+        self._degrade_overload = False
+        self._replicas = [types.SimpleNamespace(batcher=_FakeBatcher())]
+        self.healthy = {s.name: 1 for s in specs}
+        self.queued = {s.name: 0 for s in specs}
+        self.shed = {s.name: 0 for s in specs}
+        self.inflight = {s.name: 0 for s in specs}
+        self.up_calls, self.down_calls = [], []
+        self.fail_spinups = 0
+
+    def signals(self, tenant=None):
+        t = tenant
+        return {"tenant": t, "healthy": self.healthy[t],
+                "queued_rows": self.queued[t],
+                "capacity": max(1, 8 * self.healthy[t]),
+                "inflight": self.inflight[t], "shed": self.shed[t]}
+
+    def scale_up(self, tenant=None):
+        if self.fail_spinups > 0:
+            self.fail_spinups -= 1
+            raise MXNetError("provisioner exploded")
+        self.healthy[tenant] += 1
+        self.up_calls.append(tenant)
+        return self.healthy[tenant]
+
+    def scale_down(self, slot=None, tenant=None):
+        if self.healthy[tenant] <= 1:
+            return None
+        self.healthy[tenant] -= 1
+        self.down_calls.append(tenant)
+        return self.healthy[tenant]
+
+
+def test_autoscaler_ramp_trajectory_1_4_1():
+    """Queue pressure walks the group 1->4 (its max); calm walks it
+    back 4->1 — with cooldown hysteresis: one action per cooldown
+    window, never a thundering herd of spin-ups in one hot tick."""
+    spec = TenantSpec("t", slo_class="latency", min_replicas=1,
+                      max_replicas=4)
+    router = _FakeRouter([spec])
+    asc = FleetAutoscaler(router, up_thresh=0.5, down_thresh=0.05,
+                          cooldown_s=1.0, calm_ticks=2)
+    router.queued["t"] = 100
+    t = 0.0
+    for _ in range(20):
+        asc.tick(now=t)
+        t += 0.3
+    assert router.healthy["t"] == 4, "should be pinned at max_replicas"
+    assert len(router.up_calls) == 3
+    # cooldown hysteresis: successive scale-ups >= cooldown apart
+    ups = [e for e in asc.scale_events if e["event"] == "scale_up"]
+    gaps = [b["t"] - a["t"] for a, b in zip(ups, ups[1:])]
+    assert all(g >= 1.0 for g in gaps), gaps
+    # traffic drains: calm ticks walk it back down to min
+    router.queued["t"] = 0
+    for _ in range(40):
+        asc.tick(now=t)
+        t += 0.3
+    assert router.healthy["t"] == 1
+    assert len(router.down_calls) == 3
+    assert asc.report()["scale_ups"] == 3
+    assert asc.report()["scale_downs"] == 3
+
+
+def test_autoscaler_shed_triggers_scale_up():
+    """A shed burst scales up even when the queue snapshot looks calm
+    (sheds ARE the missed queue)."""
+    spec = TenantSpec("t", max_replicas=4)
+    router = _FakeRouter([spec])
+    asc = FleetAutoscaler(router, cooldown_s=0.0)
+    router.shed["t"] = 5       # delta vs the initial watermark of 0
+    asc.tick(now=0.0)
+    assert router.healthy["t"] == 2
+
+
+def test_autoscaler_spinup_failure_backoff():
+    """A failing provisioner (the ``scale_up`` fault shape) is counted
+    and retried with exponential backoff; the policy keeps ticking and
+    eventually lands the replica."""
+    spec = TenantSpec("t", max_replicas=4)
+    router = _FakeRouter([spec])
+    router.fail_spinups = 2
+    asc = FleetAutoscaler(router, cooldown_s=0.0)
+    router.queued["t"] = 100
+    asc.tick(now=0.0)          # attempt 1 fails -> backoff 0.05
+    asc.tick(now=0.01)         # inside backoff: no attempt
+    asc.tick(now=0.06)         # attempt 2 fails -> backoff 0.1
+    asc.tick(now=0.10)         # still inside backoff
+    assert router.healthy["t"] == 1
+    assert asc.report()["scaleup_failures"] == 2
+    asc.tick(now=0.20)         # backoff expired: attempt 3 succeeds
+    assert router.healthy["t"] == 2
+    assert asc.report()["scale_ups"] == 1
+    fails = [e for e in asc.scale_events
+             if e["event"] == "scale_up_failed"]
+    assert [f["fails"] for f in fails] == [1, 2]
+
+
+def test_degradation_ladder_ordering_and_unwind():
+    """Pinned at max scale and still shedding, the ladder escalates one
+    rung per tick in the pinned order — shed the lowest-priority
+    tenant, lengthen batch waits, fleet-level overload — and unwinds in
+    exactly the reverse order when pressure subsides."""
+    lat = TenantSpec("lat", slo_class="latency", max_replicas=1)
+    bat = TenantSpec("bat", slo_class="batch", max_replicas=1)
+    router = _FakeRouter([lat, bat])
+    asc = FleetAutoscaler(router, cooldown_s=0.0, calm_ticks=2)
+    base_wait = router._replicas[0].batcher.max_wait_us
+
+    def overload(t):
+        router.queued["lat"] = 100
+        router.shed["lat"] += 3     # shedding while pinned at max
+        asc.tick(now=t)
+
+    overload(0.0)
+    assert asc.degrade_rung == 1
+    assert router._tenants["bat"].degraded_shed, \
+        "rung 1 must shed the LOWEST-priority tenant"
+    assert not router._tenants["lat"].degraded_shed
+    overload(0.1)
+    assert asc.degrade_rung == 2
+    assert router._replicas[0].batcher.max_wait_us > base_wait
+    overload(0.2)
+    assert asc.degrade_rung == 3
+    assert router._degrade_overload
+    overload(0.3)
+    assert asc.degrade_rung == 3, "ladder tops out at rung 3"
+    # every rung counted in telemetry
+    snap = treg.snapshot(prefix=f"fleet::{router.telemetry_id}::degrade")
+    got = {k.rsplit("::", 1)[1]: v["value"] for k, v in snap.items()}
+    assert got == {"shed_tenant": 1, "longer_wait": 1, "overloaded": 1}
+    # pressure subsides: unwind one rung per calm streak, reverse order
+    router.queued["lat"] = 0
+    t = 1.0
+    states = []
+    for _ in range(12):
+        asc.tick(now=t)
+        t += 0.1
+        states.append((asc.degrade_rung, router._degrade_overload,
+                       router._replicas[0].batcher.max_wait_us,
+                       router._tenants["bat"].degraded_shed))
+        if asc.degrade_rung == 0:
+            break
+    assert asc.degrade_rung == 0
+    rungs = [s[0] for s in states]
+    assert all(a >= b for a, b in zip(rungs, rungs[1:])), \
+        f"unwind must be monotonic, got {rungs}"
+    assert not router._degrade_overload
+    assert router._replicas[0].batcher.max_wait_us == base_wait
+    assert not router._tenants["bat"].degraded_shed
+
+
+# -- live fleet drills --------------------------------------------------------
+
+def test_ramp_drill_scales_and_drops_nothing(ccache):
+    """The headline drill on a real fleet: a stepped client ramp drives
+    the autoscaler up from 1 replica and back down to 1, with ZERO
+    dropped admitted requests and ZERO fresh XLA traces on any
+    spin-up (every replica past the first AOT-loads from the shared
+    compile cache)."""
+    mod = _make_module("ar")
+    router = serving.FleetRouter(tenants=[
+        TenantSpec("web", factory=_factory_for(mod, "ar", max_queue=64),
+                   slo_class="latency", replicas=1, min_replicas=1,
+                   max_replicas=4)], name="ramp-fleet").start()
+    asc = FleetAutoscaler(router, up_thresh=0.2, down_thresh=0.05,
+                          cooldown_s=0.05, interval_s=0.03,
+                          calm_ticks=3)
+    try:
+        with asc:
+            res = loadgen.ramp(
+                router, _x(), tenants={"web": 1},
+                profile={"shape": "step",
+                         "steps": [(0.2, 2), (0.8, 12), (0.2, 2)]},
+                retries=80, backoff_ms=2)
+            # quiet tail: let the autoscaler walk back down to min
+            deadline = time.monotonic() + 10
+            while router.healthy_count("web") > 1 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+        rep = router.report()
+        arep = asc.report()
+        assert arep["scale_ups"] >= 1, arep
+        assert arep["scale_downs"] >= 1, arep
+        assert router.healthy_count("web") == 1
+        # zero fresh traces on every spin-up (AOT from shared cache)
+        assert rep["spinup_retraces"] == [0] * rep["scale_ups"]
+        # zero dropped admitted requests: every admission either served
+        # or was shed AT admission (client retried); none failed after
+        assert res["completed"] > 0
+        assert res["gave_up"] == 0, res
+        ten = router.tenant_report()["web"]
+        assert ten["slo_violations"] == 0
+        assert ten["served"] == res["completed"]
+        assert arep["policy_errors"] == 0
+    finally:
+        asc.stop()
+        router.stop()
+
+
+def test_two_tenant_isolation(ccache):
+    """A batch tenant flooding its own quota must not starve the
+    latency tenant sharing the fleet: the latency tenant's busy p99
+    stays within 1.5x its solo p99 (floored to absorb scheduler
+    noise), and it sheds nothing."""
+    lat_mod = _make_module("il")
+    bat_mod = _make_module("ib", seed=13)
+    x = _x()
+
+    def lat_loop(router):
+        return loadgen.closed_loop(router, x, clients=2, per_client=25,
+                                   retries=20, backoff_ms=2)
+
+    solo = serving.FleetRouter(tenants=[
+        TenantSpec("lat", factory=_factory_for(lat_mod, "il"),
+                   slo_class="latency", replicas=1)],
+        name="solo-fleet").start()
+    try:
+        p99_solo = lat_loop(solo)["p99_ms"]
+    finally:
+        solo.stop()
+
+    router = serving.FleetRouter(tenants=[
+        TenantSpec("lat", factory=_factory_for(lat_mod, "il"),
+                   slo_class="latency", replicas=1),
+        TenantSpec("bat", factory=_factory_for(bat_mod, "ib"),
+                   slo_class="batch", replicas=1)],
+        name="iso-fleet").start()
+    try:
+        out = {}
+        th = threading.Thread(target=lambda: out.update(
+            bat=_closed_loop_tenant(router, _x(1, 8), "bat")))
+        th.start()
+        time.sleep(0.05)      # flood in flight before measuring
+        busy = _closed_loop_tenant(router, x, "lat", clients=2,
+                                   per_client=25, retries=20)
+        th.join()
+        p99_busy = busy["p99_ms"]
+        floor = max(p99_solo, 10.0)
+        assert p99_busy <= 1.5 * floor, \
+            (p99_busy, p99_solo, out.get("bat"))
+        ten = router.tenant_report()
+        assert ten["lat"]["shed"] == 0, ten
+        assert busy["gave_up"] == 0
+        assert out["bat"]["completed"] > 0
+    finally:
+        router.stop()
+
+
+def _closed_loop_tenant(router, x, tenant, clients=6, per_client=25,
+                        retries=40):
+    """closed_loop aimed at one tenant (binds the tenant kwarg)."""
+    shim = types.SimpleNamespace(
+        predict=lambda data, timeout=300, **kw: router.predict(
+            data, timeout=timeout, tenant=tenant, **kw))
+    return loadgen.closed_loop(shim, x, clients=clients,
+                               per_client=per_client, retries=retries,
+                               backoff_ms=2)
+
+
+def test_hot_swap_bit_identity_and_zero_drops(ccache):
+    """``swap_weights`` under live traffic: zero dropped requests, zero
+    recompiles, and afterwards the fleet answers BIT-IDENTICALLY to a
+    fleet freshly started on the new checkpoint."""
+    mod_a = _make_module("sw", seed=7)
+    mod_b = _make_module("sw", seed=13)     # same arch, new weights
+    x = _x()
+    router = serving.FleetRouter(tenants=[
+        TenantSpec("m", factory=_factory_for(mod_a, "swa"),
+                   replicas=2)], name="swap-fleet").start()
+    try:
+        retraces0 = sum(r["retraces"]
+                        for r in router.report()["replicas"])
+        stop = threading.Event()
+        errs = []
+
+        def traffic():
+            while not stop.is_set():
+                try:
+                    router.predict(x, tenant="m", timeout=30)
+                except Exception as e:     # noqa: BLE001
+                    errs.append(e)
+
+        threads = [threading.Thread(target=traffic) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        swapped = router.swap_weights(tenant="m", module=mod_b)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert swapped == 2
+        assert not errs, errs[:3]
+        # zero recompiles: the programs are weight-independent
+        rep = router.report()
+        assert sum(r["retraces"] for r in rep["replicas"]) == retraces0
+        assert rep["swaps"] == 1
+        assert rep["tenants"]["m"]["swaps"] == 1
+        # bit-identity vs a fresh fleet on checkpoint B
+        oracle = np.asarray(mod_b.as_predictor(buckets=(2, 8))
+                            .predict(x))
+        for _ in range(4):      # hit both replicas
+            got = np.asarray(router.predict(x, tenant="m"))
+            assert np.array_equal(got, oracle)
+        assert router.tenant_report()["m"]["slo_violations"] == 0
+    finally:
+        router.stop()
+
+
+def test_scale_down_drains_in_flight(ccache):
+    """Scale-down retires through DRAINING: requests queued on the
+    condemned replica complete (zero Cancelled), and the probe loop
+    never resurrects the vacated slot."""
+    mod = _make_module("sd")
+    router = serving.FleetRouter(tenants=[
+        TenantSpec("m", factory=_factory_for(mod, "sd"), replicas=2)],
+        name="drain-fleet", probe_interval_s=0.05).start()
+    try:
+        futs = [router.submit(_x(i), tenant="m") for i in range(24)]
+        slot = router.scale_down(tenant="m")
+        assert slot is not None
+        for f in futs:
+            np.asarray(f.result(30))      # every admitted answer lands
+        assert router.healthy_count("m") == 1
+        time.sleep(0.3)                   # probe window
+        assert router.healthy_count("m") == 1, \
+            "probe loop resurrected a scaled-down slot"
+        assert router.report()["replaces"] == 0
+        assert router.tenant_report()["m"]["slo_violations"] == 0
+    finally:
+        router.stop()
+
+
+def test_scale_down_refuses_last_replica(ccache):
+    mod = _make_module("sl")
+    router = serving.FleetRouter(tenants=[
+        TenantSpec("m", factory=_factory_for(mod, "sl"), replicas=1)],
+        name="last-fleet").start()
+    try:
+        assert router.scale_down(tenant="m") is None
+        assert router.healthy_count("m") == 1
+    finally:
+        router.stop()
+
+
+def test_scale_up_fault_fails_attempt_then_recovers(ccache):
+    """The ``scale_up`` fault site fails the spin-up attempt itself
+    (slot stays vacant, no half-born replica); the autoscaler counts,
+    backs off, and lands the replica once the fault disarms."""
+    mod = _make_module("sf")
+    router = serving.FleetRouter(tenants=[
+        TenantSpec("m", factory=_factory_for(mod, "sf"), replicas=1,
+                   max_replicas=3)], name="fault-fleet").start()
+    asc = FleetAutoscaler(router, cooldown_s=0.0)
+    hot = {"tenant": "m", "healthy": 1, "queued_rows": 100,
+           "capacity": 8, "inflight": 0, "shed": 0}
+    try:
+        real_signals = router.signals
+        router.signals = lambda tenant=None: dict(
+            hot, healthy=router.healthy_count("m"))
+        with faultinject.inject("scale_up:times=2"):
+            asc.tick(now=0.0)
+            asc.tick(now=0.06)
+            assert router.healthy_count("m") == 1
+            assert asc.report()["scaleup_failures"] == 2
+            assert faultinject.fired("scale_up") == 2
+            asc.tick(now=0.30)     # fault budget exhausted: succeeds
+        router.signals = real_signals
+        assert router.healthy_count("m") == 2
+        assert asc.report()["scale_ups"] == 1
+        assert router.report()["spinup_retraces"] == [0]
+        assert asc.report()["policy_errors"] == 0
+    finally:
+        router.stop()
+
+
+def test_tenant_admit_fault_sheds_cleanly(ccache):
+    """An armed ``tenant_admit`` fault sheds that tenant's submits with
+    the tenant-tagged counter; the neighbor tenant is untouched."""
+    lat_mod = _make_module("tl")
+    bat_mod = _make_module("tb", seed=13)
+    router = serving.FleetRouter(tenants=[
+        TenantSpec("lat", factory=_factory_for(lat_mod, "tl")),
+        TenantSpec("bat", factory=_factory_for(bat_mod, "tb"),
+                   slo_class="batch")], name="admit-fleet").start()
+    try:
+        with faultinject.inject("tenant_admit:tenant=bat"):
+            with pytest.raises(Overloaded):
+                router.predict(_x(), tenant="bat")
+            np.asarray(router.predict(_x(), tenant="lat"))
+        ten = router.tenant_report()
+        assert ten["bat"]["shed"] == 1
+        assert ten["lat"]["shed"] == 0
+        snap = treg.snapshot(prefix="serving::tenant::bat::shed")
+        assert list(snap.values())[0]["value"] == 1
+        # disarmed: the tenant serves again (clean shed, no poison)
+        np.asarray(router.predict(_x(), tenant="bat"))
+    finally:
+        router.stop()
+
+
+def test_condemned_replica_series_dropped_eagerly(ccache):
+    """Regression (round-20 bugfix): a retired replica's
+    ``serving::<id>::`` registry series must vanish when the replica is
+    retired — previously they lingered until the predictor happened to
+    be garbage collected, so 20 scale cycles ballooned the registry."""
+    mod = _make_module("rg")
+    router = serving.FleetRouter(tenants=[
+        TenantSpec("m", factory=_factory_for(mod, "rg"), replicas=2,
+                   max_replicas=3)], name="gc-fleet").start()
+    try:
+        np.asarray(router.predict(_x(), tenant="m"))
+        baseline = len(treg.snapshot(prefix="serving::"))
+        for _ in range(20):
+            slot = router.scale_up("m")
+            assert router.scale_down(slot=slot, tenant="m") == slot
+            # NOTE: no gc.collect() — eager removal must not depend on
+            # the collector visiting the dead predictor
+            n = len(treg.snapshot(prefix="serving::"))
+            assert n <= baseline + 0, \
+                f"registry grew to {n} series (baseline {baseline})"
+        rep = router.report()
+        assert rep["scale_ups"] == 20 and rep["scale_downs"] == 20
+    finally:
+        router.stop()
+
+
+def test_replaced_replica_series_dropped_eagerly(ccache):
+    """Same bugfix, replacement path: when the probe loop swaps in a
+    fresh replica for a dead one, the dead replica's series drop
+    immediately."""
+    mod = _make_module("rp")
+    router = serving.FleetRouter(tenants=[
+        TenantSpec("m", factory=_factory_for(mod, "rp"), replicas=2)],
+        name="rep-fleet", probe_interval_s=0.05).start()
+    try:
+        np.asarray(router.predict(_x(), tenant="m"))
+        baseline = len(treg.snapshot(prefix="serving::"))
+        dead_id = router._replicas[0].predictor.telemetry_id
+        with faultinject.inject(replica_drop={"replica": dead_id}):
+            run = loadgen.closed_loop(router, _x(), clients=4,
+                                      per_client=10, retries=3,
+                                      backoff_ms=10)
+        assert run["gave_up"] == 0
+        deadline = time.monotonic() + 10
+        while router.report()["replaces"] < 1 and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert router.report()["replaces"] >= 1
+        assert not treg.snapshot(prefix=f"serving::{dead_id}::"), \
+            "dead replica's registry series lingered after replacement"
+        assert len(treg.snapshot(prefix="serving::")) <= baseline
+    finally:
+        router.stop()
+
+
+# -- loadgen ramp profiles ----------------------------------------------------
+
+def test_ramp_profile_expansion():
+    steps = loadgen._expand_profile(
+        {"shape": "step", "steps": [(0.5, 1), (1.0, 8), (0.5, 1)]})
+    assert steps == [(0.5, 1), (1.0, 8), (0.5, 1)]
+    sine = loadgen._expand_profile(
+        {"shape": "sine", "period_s": 8.0, "min_clients": 1,
+         "max_clients": 9, "duration_s": 8.0, "step_s": 1.0})
+    assert len(sine) == 8
+    assert abs(sum(d for d, _ in sine) - 8.0) < 1e-9
+    clients = [c for _, c in sine]
+    assert clients[0] == 1, "sine starts at min_clients"
+    assert max(clients) == 9, "sine peaks at max_clients"
+    assert clients[1] < clients[3], "rising edge"
+    with pytest.raises(ValueError):
+        loadgen._expand_profile({"shape": "sawtooth"})
+
+
+def test_ramp_per_tenant_mix_is_weighted(ccache):
+    mod = _make_module("mix")
+    router = serving.FleetRouter(tenants=[
+        TenantSpec("a", factory=_factory_for(mod, "mixa")),
+        TenantSpec("b", factory=_factory_for(mod, "mixb"),
+                   slo_class="batch")], name="mix-fleet").start()
+    try:
+        res = loadgen.ramp(
+            router, _x(), tenants={"a": 3, "b": 1},
+            profile={"shape": "step", "steps": [(0.4, 4)]},
+            retries=20, backoff_ms=2)
+        a = res["tenants"]["a"]["completed"]
+        b = res["tenants"]["b"]["completed"]
+        assert a > 0 and b > 0
+        # deterministic 3:1 wheel (tolerate edge requests in flight)
+        assert 1.5 <= a / b <= 4.5, (a, b)
+        assert res["phases"][0]["clients"] == 4
+    finally:
+        router.stop()
+
+
+# -- multi-host supervisor contract -------------------------------------------
+
+def _elastic_env():
+    env = dict(os.environ)
+    env.pop("MXTPU_FAULT_INJECT", None)
+    env["MXTPU_FT_DIST_DEADLINE"] = "6"
+    env["MXTPU_FLEET_HEARTBEAT_S"] = "0.2"
+    env["MXTPU_FLEET_LEASE_S"] = "1.0"
+    return env
+
+
+def test_supervisor_handshake_check(tmp_path):
+    """check_env machine-checks a worker's env against its host's
+    published rank file — and names the first mismatch."""
+    spec = SupervisorSpec(str(tmp_path), hosts=2, procs_per_host=1,
+                          lease_s=1.0)
+    spec.write_ranks(0, 1, [1], world=2, coordinator="127.0.0.1:7777")
+    good = spec.handshake_env(1, 2, 0, "127.0.0.1:7777", 1)
+    ident = SupervisorSpec.check_env(good)
+    assert ident == {"rank": 1, "world": 2, "generation": 0,
+                     "host": 1, "coordinator": "127.0.0.1:7777"}
+    # not under a supervisor: no-op
+    assert SupervisorSpec.check_env({}) is None
+    # wrong rank for this host
+    bad = dict(good, PROCESS_ID="0")
+    with pytest.raises(MXNetError, match="rank 0 not in"):
+        SupervisorSpec.check_env(bad)
+    # stale world size
+    bad = dict(good, NUM_PROCESSES="3")
+    with pytest.raises(MXNetError, match="world"):
+        SupervisorSpec.check_env(bad)
+    # generation from a previous mesh
+    bad = dict(good, MXTPU_ELASTIC_GENERATION="5")
+    with pytest.raises(MXNetError, match="no rank file"):
+        SupervisorSpec.check_env(bad)
+
+
+def test_two_host_supervisor_reform_drill(tmp_path):
+    """The 2-"host" drill: host 1 (a launch.py --elastic subprocess
+    tree) is SIGKILLed whole mid-generation. Host 0's controller sees
+    its alive lease go stale and its exit codes never land, declares a
+    WHOLE-host loss, and re-forms the survivors at world=1 — which
+    completes training. The exit-75 relaunch protocol, machine-checked
+    across hosts."""
+    workdir = str(tmp_path)
+    env = _elastic_env()
+    spec = SupervisorSpec(workdir, hosts=2, procs_per_host=1,
+                          lease_s=1.0)
+    host1 = subprocess.Popen(
+        [sys.executable, os.path.join(_TOOLS, "launch.py"),
+         "--elastic", "--hosts", "2", "--host-id", "1",
+         "--workdir", workdir, "--lease-s", "1.0", "--timeout", "60",
+         sys.executable, _ELASTIC_WORKER, workdir, "3"],
+        env=env, start_new_session=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    def killer():
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            ctrl = spec.read_control()
+            if ctrl and ctrl.get("generation") == 0 and \
+                    os.path.exists(spec.ranks_path(0, 1)):
+                break
+            time.sleep(0.1)
+        time.sleep(2.0)        # let generation 0 actually train
+        try:
+            os.killpg(host1.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+    th = threading.Thread(target=killer, daemon=True)
+    th.start()
+    sup = HostSupervisor(
+        spec, 0,
+        lambda r, w, g, c: [sys.executable, _ELASTIC_WORKER, workdir,
+                            "3"],
+        env=env, timeout_s=60, max_generations=4)
+    try:
+        history = sup.run()
+    finally:
+        th.join(timeout=5)
+        if host1.poll() is None:
+            try:
+                os.killpg(host1.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        host1.communicate()
+    assert history[-1]["outcome"] == "done", \
+        [h.get("outcome") for h in history]
+    assert any(h.get("lost_hosts") == [1] for h in history), history
+    assert history[0]["world"] == 2
+    assert history[-1]["world"] == 1
+    # the surviving generation's worker passed the handshake check
+    done_logs = "".join(history[-1]["logs"])
+    assert "supervisor handshake ok" in done_logs

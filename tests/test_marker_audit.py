@@ -381,3 +381,30 @@ def test_quant_suite_stays_tier1():
     assert "test_quant.py" not in uses.get("slow", set()), (
         "test_quant.py cases must not be slow-marked — the "
         "quantization pins are round-19 acceptance criteria")
+
+
+def test_autoscale_suite_stays_tier1_with_chaos_marked():
+    """The autoscale suite carries the round-20 acceptance pins: the
+    scripted 1->4->1 hysteresis trajectory, the degradation-ladder
+    ordering, zero-drop hot-swap bit-identity, the condemned-replica
+    registry bugfix, and the 2-host supervisor re-form drill. It must
+    exist, be chaos+serving marked at module level (the chaos sweep
+    and the serving sweep both pick it up), and never carry ``slow`` —
+    every case runs on the pocket MLP in seconds."""
+    path = os.path.join(_TESTS, "test_autoscale.py")
+    assert os.path.exists(path), "tests/test_autoscale.py missing"
+    with open(path) as f:
+        src = f.read()
+    m = re.search(r"^pytestmark\s*=.*$", src, re.M)
+    assert m is not None, (
+        "test_autoscale.py must declare a module-level pytestmark")
+    assert "chaos" in m.group(0) and "serving" in m.group(0), (
+        "test_autoscale.py must be chaos+serving marked — the fault "
+        "drills belong to both sweeps")
+    assert "slow" not in m.group(0), (
+        "test_autoscale.py must stay tier-1: a module-level slow mark "
+        "drops the autoscaler and hot-swap pins from the gate")
+    uses = _mark_uses()
+    assert "test_autoscale.py" not in uses.get("slow", set()), (
+        "test_autoscale.py cases must not be slow-marked — the "
+        "autoscale pins are round-20 acceptance criteria")

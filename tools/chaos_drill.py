@@ -36,6 +36,24 @@ recovery assertion that makes it a drill rather than a demo:
     (completed epochs never re-run) and the final params to be
     bit-identical across ranks.
 
+``ramp_scale``
+    Autoscaling drill (round 20): closed-loop clients ramp 1->8->1
+    against a 1-replica fleet under a FleetAutoscaler, with BOTH
+    round-20 fault sites armed — the first spin-up attempt fails
+    (``scale_up``, the flaky-provisioner shape; the autoscaler must
+    count it, back off, retry) and one replica is poisoned mid-ramp
+    (``replica_drop``). PASS requires zero dropped admitted requests,
+    every spin-up AOT-loaded (0 fresh traces), the poisoned replica
+    replaced, and the fleet back at 1 replica after the ramp drains.
+
+``hot_swap``
+    Weight hot-swap drill (round 20): ``router.swap_weights`` swaps a
+    new checkpoint into every replica WHILE closed-loop clients hold
+    the fleet at its admission limit. PASS requires zero dropped
+    requests, zero fresh XLA traces, and the post-swap fleet answering
+    bit-identically to a predictor freshly built on the new
+    checkpoint.
+
 Usage:
     python tools/chaos_drill.py [--scenario S] [--workdir D]
         [--epochs N] [--fault SPEC] [--corrupt]   # ckpt knobs
@@ -45,7 +63,7 @@ Usage:
 The CLI exists to run these against real machines and real storage
 (NFS, FUSE, network disks) where the semantics the guarantees stand on
 actually vary; fixed-coordinate twins run in CI (tests/test_fleet.py,
-tests/test_failure_resume.py).
+tests/test_autoscale.py, tests/test_failure_resume.py).
 """
 import argparse
 import os
@@ -200,6 +218,190 @@ def drill_replica_drop(args, workdir):
     return 0 if ok else 1
 
 
+def _pocket_module(prefix, seed=7):
+    import mxnet_tpu as mx
+    mx.random.seed(seed)
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=32,
+                                name=f"{prefix}_fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name=f"{prefix}_relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=10,
+                                name=f"{prefix}_fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    mod = mx.mod.Module(context=mx.cpu(), symbol=net)
+    mod.bind(data_shapes=[("data", (8, 16))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+    return mod
+
+
+def drill_ramp_scale(args, workdir):
+    """Traffic ramp vs the autoscaler, with a flaky provisioner AND a
+    replica kill mid-ramp. Zero dropped admitted requests, zero fresh
+    traces, fleet back at its floor when traffic drains."""
+    os.environ["MXTPU_COMPILE_CACHE_DIR"] = os.path.join(workdir,
+                                                         "ccache")
+    import numpy as np
+
+    from mxnet_tpu import faultinject, serving
+    from mxnet_tpu.serving import (FleetAutoscaler, TenantSpec,
+                                   loadgen)
+
+    mod = _pocket_module("rs")
+
+    def factory():
+        pred = mod.as_predictor(buckets=(2, 8))
+        return serving.DynamicBatcher(pred, max_wait_us=1000,
+                                      max_queue=64, name="rampchaos")
+
+    x = np.random.RandomState(0).rand(2, 16).astype(np.float32)
+    router = serving.FleetRouter(tenants=[
+        TenantSpec("web", factory=factory, slo_class="latency",
+                   replicas=1, min_replicas=1, max_replicas=4)],
+        name="ramp-chaos", probe_interval_s=0.2).start()
+    asc = FleetAutoscaler(router, up_thresh=0.2, down_thresh=0.05,
+                          cooldown_s=0.05, interval_s=0.03,
+                          calm_ticks=3)
+    print("[1/4] fleet of 1 up; autoscaler armed (max 4); first "
+          "spin-up attempt will FAIL (scale_up fault)")
+    victim = router._replicas[0].predictor.telemetry_id
+
+    def kill_mid_ramp():
+        # poison the original replica once the ramp is at its peak
+        time.sleep(0.6)
+        print(f"[2/4] poisoning replica {victim!r} mid-ramp")
+
+    import threading
+    killer = threading.Thread(target=kill_mid_ramp, daemon=True)
+    with asc:
+        with faultinject.inject(
+                "scale_up:times=1;"
+                f"replica_drop:replica={victim}:call=40"):
+            killer.start()
+            run = loadgen.ramp(
+                router, x, tenants={"web": 1},
+                profile={"shape": "step",
+                         "steps": [(0.3, 1), (1.2, 8), (0.3, 1)]},
+                retries=100, backoff_ms=2)
+        print("[3/4] ramp done; waiting for scale-down to the floor")
+        deadline = time.monotonic() + 15
+        while router.healthy_count("web") > 1 and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+    rep = router.report()
+    arep = asc.report()
+    router.stop()
+
+    print(f"[4/4] completed={run['completed']} gave_up={run['gave_up']}"
+          f" scale_ups={arep['scale_ups']} "
+          f"scale_downs={arep['scale_downs']} "
+          f"spinup_failures={arep['scaleup_failures']} "
+          f"spinup_retraces={rep['spinup_retraces']} "
+          f"replaces={rep['replaces']}")
+    ok = True
+    if run["gave_up"] or run["completed"] == 0:
+        print("FAIL: admitted requests were dropped across the ramp")
+        ok = False
+    if arep["scaleup_failures"] < 1:
+        print("FAIL: the scale_up fault never fired — the flaky-"
+              "provisioner path went untested")
+        ok = False
+    if arep["scale_ups"] < 1 or arep["scale_downs"] < 1:
+        print("FAIL: the ramp never drove a full scale cycle")
+        ok = False
+    if any(n != 0 for n in rep["spinup_retraces"]):
+        print(f"FAIL: a spin-up took fresh XLA traces "
+              f"({rep['spinup_retraces']}) — must AOT-load")
+        ok = False
+    if arep["policy_errors"]:
+        print("FAIL: the policy thread swallowed errors "
+              f"({arep['policy_errors']})")
+        ok = False
+    ten = rep["tenants"]["web"]
+    if ten["slo_violations"]:
+        print(f"FAIL: {ten['slo_violations']} admitted requests "
+              "failed after admission")
+        ok = False
+    if ok:
+        print("PASS: 1->8->1 ramp with failed spin-up + replica kill: "
+              "zero dropped, zero fresh traces, fleet back at floor")
+    return 0 if ok else 1
+
+
+def drill_hot_swap(args, workdir):
+    """swap_weights during overload: zero drops, zero recompiles,
+    bit-identical to a fresh fleet on the new checkpoint."""
+    os.environ["MXTPU_COMPILE_CACHE_DIR"] = os.path.join(workdir,
+                                                         "ccache")
+    import threading
+
+    import numpy as np
+
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving import TenantSpec, loadgen
+
+    mod_a = _pocket_module("hs", seed=7)
+    mod_b = _pocket_module("hs", seed=13)   # same arch, new weights
+
+    def factory():
+        pred = mod_a.as_predictor(buckets=(2, 8))
+        return serving.DynamicBatcher(pred, max_wait_us=1000,
+                                      max_queue=32, name="swapchaos")
+
+    x = np.random.RandomState(0).rand(2, 16).astype(np.float32)
+    router = serving.FleetRouter(tenants=[
+        TenantSpec("m", factory=factory, replicas=args.replicas)],
+        name="swap-chaos").start()
+    retraces0 = sum(r["retraces"] for r in router.report()["replicas"])
+    print(f"[1/3] fleet of {args.replicas} up; flooding to the "
+          "admission limit, then swapping weights mid-overload")
+    out = {}
+    th = threading.Thread(target=lambda: out.update(
+        run=loadgen.closed_loop(router, x, clients=8, per_client=40,
+                                retries=100, backoff_ms=2)))
+    th.start()
+    time.sleep(0.1)
+    swapped = router.swap_weights(tenant="m", module=mod_b)
+    th.join()
+    run = out["run"]
+    rep = router.report()
+    oracle = np.asarray(mod_b.as_predictor(buckets=(2, 8)).predict(x))
+    bit_ok = all(
+        np.array_equal(np.asarray(router.predict(x)), oracle)
+        for _ in range(2 * args.replicas))
+    router.stop()
+
+    retrace_delta = sum(r["retraces"]
+                        for r in rep["replicas"]) - retraces0
+    print(f"[2/3] swapped={swapped} completed={run['completed']} "
+          f"gave_up={run['gave_up']} retrace_delta={retrace_delta} "
+          f"swap_wall_s={rep['last_swap_s']:.3f}")
+    print("[3/3] bit-identity vs fresh fleet on the new checkpoint: "
+          + ("OK" if bit_ok else "MISMATCH"))
+    ok = True
+    if swapped != args.replicas:
+        print(f"FAIL: only {swapped}/{args.replicas} replicas swapped")
+        ok = False
+    if run["gave_up"] or run["completed"] != run["submitted"]:
+        print("FAIL: requests dropped during the swap")
+        ok = False
+    if retrace_delta:
+        print(f"FAIL: the swap recompiled ({retrace_delta} fresh "
+              "traces) — params must restage as program arguments")
+        ok = False
+    if not bit_ok:
+        print("FAIL: post-swap outputs differ from a fresh fleet on "
+              "the new checkpoint")
+        ok = False
+    if rep["tenants"]["m"]["slo_violations"]:
+        print("FAIL: admitted requests failed during the swap")
+        ok = False
+    if ok:
+        print("PASS: weight hot-swap under overload: zero dropped, "
+              "zero recompiles, bit-identical to fresh fleet")
+    return 0 if ok else 1
+
+
 def _elastic_env():
     env = dict(os.environ)
     env.pop("MXTPU_FAULT_INJECT", None)
@@ -335,7 +537,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scenario", default="ckpt",
                     choices=("ckpt", "replica_drop", "heartbeat_miss",
-                             "dist_drop"))
+                             "dist_drop", "ramp_scale", "hot_swap"))
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--epochs", type=int, default=4)
     ap.add_argument("--fault",
@@ -354,7 +556,9 @@ def main():
     drill = {"ckpt": drill_ckpt,
              "replica_drop": drill_replica_drop,
              "heartbeat_miss": drill_heartbeat_miss,
-             "dist_drop": drill_dist_drop}[args.scenario]
+             "dist_drop": drill_dist_drop,
+             "ramp_scale": drill_ramp_scale,
+             "hot_swap": drill_hot_swap}[args.scenario]
     return drill(args, workdir)
 
 

@@ -10,6 +10,18 @@ PROCESS_ID set (consumed by mxnet_tpu.parallel.dist.init). Multi-host
 clusters use the same env contract with your scheduler of choice.
 
 Usage: python tools/launch.py -n 4 python train.py --kv-store dist_sync
+
+``--elastic`` switches to the round-20 multi-host supervisor contract
+(mxnet_tpu.parallel.elastic.SupervisorSpec / HostSupervisor): run ONE
+launcher per host, all pointed at a shared ``--workdir``; host 0
+publishes membership/generation/coordinator in ``control.json``, every
+host launches only its own ranks with the machine-checked handshake
+env, and a whole-host loss (SIGKILL the launcher tree) re-forms the
+survivors at the shrunken world — the exit-75 relaunch protocol,
+across hosts:
+
+    python tools/launch.py --elastic --hosts 2 --host-id 0 \\
+        --procs-per-host 1 --workdir /shared/job1 python worker.py ...
 """
 import argparse
 import os
@@ -26,18 +38,64 @@ def find_free_port():
     return port
 
 
+def run_elastic(args):
+    """One host's share of the multi-host supervisor contract."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir))
+    from mxnet_tpu.parallel.elastic import (HostSupervisor,
+                                            SupervisorSpec)
+    spec = SupervisorSpec(args.workdir, hosts=args.hosts,
+                          procs_per_host=args.procs_per_host,
+                          lease_s=args.lease_s)
+    sup = HostSupervisor(
+        spec, args.host_id,
+        argv_fn=lambda rank, world, gen, coord: list(args.command),
+        timeout_s=args.timeout, max_generations=args.max_generations)
+    history = sup.run()
+    if args.host_id == 0:
+        last = history[-1] if history else {}
+        ok = last.get("outcome") == "done"
+        print(f"elastic fleet: {len(history)} generation(s), "
+              f"outcome={last.get('outcome')}", file=sys.stderr)
+        sys.exit(0 if ok else 1)
+    sys.exit(0)
+
+
 def main():
     parser = argparse.ArgumentParser(
         description="launch a local N-process jax.distributed job")
-    parser.add_argument("-n", "--num-workers", type=int, required=True,
+    parser.add_argument("-n", "--num-workers", type=int, default=None,
                         help="number of worker processes")
     parser.add_argument("--coordinator", default=None,
                         help="host:port (default: localhost + free port)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="run as one host of a multi-host elastic "
+                             "supervisor fleet (requires --workdir)")
+    parser.add_argument("--hosts", type=int, default=2,
+                        help="[elastic] total hosts in the fleet")
+    parser.add_argument("--host-id", type=int, default=0,
+                        help="[elastic] this host's id (0 = controller)")
+    parser.add_argument("--procs-per-host", type=int, default=1,
+                        help="[elastic] worker processes per host")
+    parser.add_argument("--workdir", default=None,
+                        help="[elastic] shared supervisor workdir")
+    parser.add_argument("--timeout", type=float, default=240,
+                        help="[elastic] per-generation worker timeout")
+    parser.add_argument("--max-generations", type=int, default=6,
+                        help="[elastic] re-form budget")
+    parser.add_argument("--lease-s", type=float, default=None,
+                        help="[elastic] host alive-lease TTL")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="command to run in every worker")
     args = parser.parse_args()
     if not args.command:
         parser.error("no command given")
+    if args.elastic:
+        if not args.workdir:
+            parser.error("--elastic requires --workdir")
+        return run_elastic(args)
+    if args.num_workers is None:
+        parser.error("-n/--num-workers is required without --elastic")
 
     coordinator = args.coordinator or f"127.0.0.1:{find_free_port()}"
     procs = []

@@ -9,7 +9,8 @@ operational surface:
     telemetry.py summary [--dir D] [--json]
     telemetry.py diff    A.json B.json [--json]
                          [--gate-bytes] [--gate-peak-mem]
-                         [--gate-shed-rate] [--tolerance PCT]
+                         [--gate-shed-rate] [--gate-slo]
+                         [--tolerance PCT]
     telemetry.py render  [--dir D]
     telemetry.py fleet   [--dir D] [--json] [--straggler-factor F]
     telemetry.py trace   [PATH] [--dir D] [--json]
@@ -64,6 +65,15 @@ section (a pre-r19 baseline reports the new readings ungated, the
 ``multichip_fused`` precedent). A growing ratio means quantization is
 buying fewer bytes than it used to — a quantization regression even
 when absolute bytes shrank for other reasons.
+
+Round 20 (autoscaling + multi-tenancy): ``diff --gate-slo`` reads a
+BENCH file's ``fleet_autoscale`` section — per-tenant
+``slo_violations`` counts from the chaos-drilled ramp (requests that
+completed over the tenant's latency target, or failed after
+admission) — and exits 2 when ANY tenant in the NEW run violated.
+Unlike the relative gates this one is absolute: the tenant contract
+is zero violations, so a pre-r20 baseline without the section only
+changes the report's note, never the verdict.
 
 Pure file-level operations: no accelerator backend is initialized.
 """
@@ -356,6 +366,28 @@ def _load_shed_rate(tree, path):
              "snapshot/BENCH file, or the run served no fleet traffic")
 
 
+def _load_slo_violations(tree, path, required=True):
+    """Per-tenant SLO-violation counts from a BENCH JSON's
+    ``fleet_autoscale`` section (round 20): ``tenants.<name>.
+    slo_violations`` counts requests that completed over the tenant's
+    latency target PLUS requests the fleet failed after admission.
+    Returns {tenant: count}, or None when the file predates the
+    section (required=False)."""
+    fa = tree.get("fleet_autoscale")
+    if isinstance(fa, dict) and isinstance(fa.get("tenants"), dict):
+        out = {}
+        for name, t in fa["tenants"].items():
+            if isinstance(t, dict) and "slo_violations" in t:
+                out[name] = int(t["slo_violations"])
+        if out:
+            return out
+    if required:
+        sys.exit(f"{path}: no fleet_autoscale.tenants.*.slo_violations "
+                 "readings — not a round-20 BENCH file, or the run "
+                 "drove no multi-tenant fleet traffic")
+    return None
+
+
 def _flat_values(tree):
     """metric -> comparable scalar for the metric-by-metric diff."""
     out = {}
@@ -481,6 +513,26 @@ def cmd_diff(args):
             "tolerance_pct": args.tolerance,
             "regressed": shed_failed,
         }
+    slo_failed = False
+    if args.gate_slo:
+        new_slo = _load_slo_violations(new_t, args.new)
+        old_slo = _load_slo_violations(old_t, args.old, required=False)
+        # the SLO gate is ABSOLUTE, not relative: a tenant's contract
+        # is "zero admitted requests violated", so ANY violation in
+        # the new run fails regardless of what the baseline did
+        bad = {t: v for t, v in sorted(new_slo.items()) if v > 0}
+        slo_failed = bool(bad)
+        result["gate_slo"] = {
+            "old_slo_violations": old_slo,
+            "new_slo_violations": new_slo,
+            "violating_tenants": bad,
+            "regressed": slo_failed,
+        }
+        if old_slo is None:
+            result["gate_slo"]["note"] = (
+                f"{args.old} has no fleet_autoscale section (pre-r20 "
+                "baseline) — the gate is absolute on the new run "
+                "anyway")
     if args.json:
         print(json.dumps(result, indent=1))
     else:
@@ -535,6 +587,12 @@ def cmd_diff(args):
             print(f"shed rate: {g['old_shed_rate']:.6g} -> "
                   f"{g['new_shed_rate']:.6g} (tolerance "
                   f"{args.tolerance}%)")
+        if args.gate_slo:
+            g = result["gate_slo"]
+            readings = ", ".join(f"{t}={v}" for t, v in
+                                 sorted(g["new_slo_violations"].items()))
+            print(f"per-tenant SLO violations: {readings}"
+                  + (f" [{g['note']}]" if g.get("note") else ""))
     if gate_failed:
         if result["gate_bytes"]["regressed"]:
             print(f"BYTES REGRESSION: {BYTES_METRIC} grew "
@@ -584,7 +642,18 @@ def cmd_diff(args):
               "router stopped re-dispatching. Each shed is a client "
               "retry or a dropped answer. Fix the fleet or re-baseline "
               "deliberately.", file=sys.stderr)
-    if gate_failed or mem_failed or shed_failed:
+    if slo_failed:
+        g = result["gate_slo"]
+        viol = ", ".join(f"{t}: {v}" for t, v in
+                         g["violating_tenants"].items())
+        print(f"SLO VIOLATION: tenants violated their contract during "
+              f"the autoscale run ({viol}) — an admitted request "
+              "either completed over its tenant's latency target or "
+              "failed after admission. The contract is absolute "
+              "(zero): fix the fleet (capacity, hysteresis, the "
+              "degradation ladder) — there is no re-baselining an SLO "
+              "away.", file=sys.stderr)
+    if gate_failed or mem_failed or shed_failed or slo_failed:
         return 2
     if args.gate_bytes:
         print("bytes gate OK", file=sys.stderr)
@@ -592,6 +661,8 @@ def cmd_diff(args):
         print("peak-mem gate OK", file=sys.stderr)
     if args.gate_shed_rate:
         print("shed-rate gate OK", file=sys.stderr)
+    if args.gate_slo:
+        print("slo gate OK", file=sys.stderr)
     return 0
 
 
@@ -898,6 +969,11 @@ def main(argv=None):
     p.add_argument("--gate-peak-mem", action="store_true",
                    help="exit 2 when mem::process_peak_bytes grew "
                         "beyond --tolerance")
+    p.add_argument("--gate-slo", action="store_true",
+                   help="exit 2 when any tenant in the new BENCH "
+                        "file's fleet_autoscale section counted an "
+                        "SLO violation (absolute gate: the contract "
+                        "is zero)")
     p.add_argument("--gate-shed-rate", action="store_true",
                    help="exit 2 when the fleet shed rate "
                         "(fleet::shed_rate / fleet_serving.shed_rate) "
