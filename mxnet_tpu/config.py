@@ -154,6 +154,31 @@ register("MXTPU_DECODE_MAX_WAIT_US", 2000, int,
 register("MXTPU_DECODE_MAX_QUEUE", 256, int,
          "DecodeBatcher admission bound in queued REQUESTS; submits "
          "past it fail fast with serving.Overloaded")
+register("MXTPU_SPEC_K", 4, int,
+         "Speculation depth for speculative decoding (serving/decode/"
+         "spec.py): draft tokens proposed per lane per round; the "
+         "target verifies k+1 fed tokens in ONE program and emits "
+         "1..k+1 tokens. Verify width k+1 is compile-key material")
+register("MXTPU_SPEC_DISABLE_BELOW", 0.125, float,
+         "Acceptance-rate floor for speculative decoding: when the "
+         "windowed draft-acceptance rate drops below this, the engine "
+         "degrades to plain decode (speculation costs bytes it no "
+         "longer repays) and re-probes after MXTPU_SPEC_PROBE_STEPS")
+register("MXTPU_SPEC_PROBE_STEPS", 64, int,
+         "How many plain-decode rounds a degraded speculative engine "
+         "serves before probing speculation again")
+register("MXTPU_SPEC_WINDOW", 32, int,
+         "Sliding window (verify rounds) over which the speculative "
+         "engine computes its acceptance rate / accepted-per-step "
+         "gauges and the degrade decision")
+register("MXTPU_FLEET_ROLE_PREFILL", 0, int,
+         "Default prefill-role replica count for a TenantSpec that "
+         "doesn't set prefill_replicas: >0 (with MXTPU_FLEET_ROLE_"
+         "DECODE) runs the tenant disaggregated — prefill replicas "
+         "fill KV lanes and hand them to decode replicas")
+register("MXTPU_FLEET_ROLE_DECODE", 0, int,
+         "Default decode-role replica count for a TenantSpec that "
+         "doesn't set decode_replicas (see MXTPU_FLEET_ROLE_PREFILL)")
 register("MXTPU_CKPT_KEEP", 3, int,
          "CheckpointManager retention: newest K valid checkpoints "
          "survive pruning (checkpoint.py)")

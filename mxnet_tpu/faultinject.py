@@ -5,7 +5,8 @@ site (``ckpt_write``, ``nan_grad``, ``data_iter``, ``data_worker``,
 ``dist_drop``, ``dist_init``, ``ckpt_truncate``, ``compile_cache``,
 ``telemetry_write``, ``sparse_update``, ``slow_step``,
 ``tune_trial``, ``decode_step``, ``replica_drop``,
-``heartbeat_miss``, ``scale_up``, ``tenant_admit``) plus
+``heartbeat_miss``, ``scale_up``, ``tenant_admit``,
+``spec_verify``, ``kv_handoff``) plus
 the exact coordinate at which it fires (byte offset, step index, batch
 index, call ordinal). ``telemetry_write`` is consulted by the durable
 telemetry exporter (telemetry/export.py) on every event append
@@ -61,7 +62,19 @@ drill). ``tenant_admit`` is consulted at every tenant-routed
 ``FleetRouter.submit`` admission (``tenant=<name>``): a fire sheds
 that request cleanly with the tenant-tagged shed counter — the
 admission-failure drill proving a broken tenant never poisons its
-neighbors. The same spec
+neighbors. ``spec_verify`` is consulted once per SPECULATIVE round by
+``SpecDecodePredictor.spec_step`` (serving/decode/spec.py,
+``round=N``): a fire simulates a draft/target divergence storm — the
+round's proposals are replaced with deliberately wrong tokens, the
+verify program still runs for real, acceptance records zero, and the
+windowed degrade policy must drop to plain decode — the stream stays
+bit-exact throughout (accept-prefix is unconditionally correct);
+``action=kill`` is the SIGKILL-mid-speculation drill. ``kv_handoff``
+is consulted at every disaggregated KV-lane transfer
+(serving/decode/batcher.py, ``call=N``): a raise loses the handoff
+after prefill — the decode side must RE-PREFILL the lane locally and
+resume the stream with zero dropped tokens — and ``action=kill``
+SIGKILLs mid-transfer. The same spec
 always produces the same failure, so CI chaos suites are reproducible
 bit-for-bit (contrast: the classic chaos-monkey coin flip, useless as a
 regression gate).

@@ -21,6 +21,12 @@ Policy, per tenant per tick:
 - **down** when load has stayed below ``MXTPU_FLEET_SCALE_DOWN_THRESH``
   with zero sheds for ``calm_ticks`` consecutive ticks and the group
   is above ``min_replicas``. Scale-down is always the polite path.
+- **role-aware** (round 21): for a disaggregated tenant the up
+  decision also picks WHICH side to grow — the per-role queue loads in
+  ``router.signals()["roles"]`` name the laggard (prefill backlog ->
+  one more prefill replica; decode lanes saturated -> one more decode
+  replica). The router's own guards keep the formation sane (a
+  scale-down never retires the last replica of a role).
 
 **Degradation ladder** — when a tenant is overloaded (shedding) while
 already pinned at max scale, adding capacity is off the table, so the
@@ -209,8 +215,24 @@ class FleetAutoscaler:
                 return shed_delta > 0    # pinned at max and still shedding
             if not cooled or now < pol.retry_at:
                 return False
+            role = None
+            if sig.get("disaggregated"):
+                # role-aware scaling: grow the side that is actually
+                # behind (per-role queue load from router.signals)
+                roles = sig.get("roles", {})
+
+                def _load(rname):
+                    d = roles.get(rname, {})
+                    return d.get("queued_rows", 0) / \
+                        max(1, d.get("capacity", 1))
+                role = "prefill" if _load("prefill") > _load("decode") \
+                    else "decode"
             try:
-                slot = self.router.scale_up(tname)
+                # only disaggregated tenants pass role= — unified
+                # groups keep the r20 call shape so duck-typed routers
+                # without the kwarg stay compatible
+                slot = self.router.scale_up(tname, role=role) \
+                    if role is not None else self.router.scale_up(tname)
             except Exception as e:
                 with self._lock:
                     self.scaleup_failures += 1
@@ -228,7 +250,8 @@ class FleetAutoscaler:
                 self.scale_ups += 1
             self._event("scale_up", now, tenant=tname, slot=slot,
                         healthy=sig["healthy"] + 1,
-                        load=round(load, 4), shed_delta=shed_delta)
+                        load=round(load, 4), shed_delta=shed_delta,
+                        role=role or "unified")
             return False
 
         calm = load < self.down_thresh and shed_delta == 0 and \

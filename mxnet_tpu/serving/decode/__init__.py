@@ -20,14 +20,26 @@ generation instead:
   mid-flight, and ``submit()`` returns a :class:`StreamFuture` that
   streams tokens as they decode. TTFT and inter-token latency feed
   ``serving::<pid>::ttft_ms`` / ``::inter_token_ms`` histograms.
+  Round 21: the batcher also speaks the disaggregated roles
+  (``role="prefill"`` hands freshly filled KV lanes to a sink,
+  ``adopt()`` receives them) and steps speculative predictors through
+  ``spec_step`` (multiple tokens per round, bit-identical streams).
+- ``spec.SpecDecodePredictor`` — speculative decoding (round 21): a
+  small distilled draft proposes up to ``k`` tokens per lane, ONE
+  batched verify program checks them all, the accepted prefix commits.
+  ``make_draft_spec`` / ``distill_draft`` build and train the draft.
 
 Config: ``MXTPU_DECODE_SLOTS``, ``MXTPU_DECODE_SEQ_BUCKETS``,
-``MXTPU_DECODE_MAX_WAIT_US``, ``MXTPU_DECODE_MAX_QUEUE``.
+``MXTPU_DECODE_MAX_WAIT_US``, ``MXTPU_DECODE_MAX_QUEUE``,
+``MXTPU_SPEC_K``, ``MXTPU_SPEC_DISABLE_BELOW``,
+``MXTPU_SPEC_PROBE_STEPS``, ``MXTPU_SPEC_WINDOW``.
 """
 from . import model
 from .model import TransformerLMSpec, build_symbol, init_params
 from .engine import DecodePredictor
 from .batcher import DecodeBatcher, StreamFuture
+from .spec import SpecDecodePredictor, make_draft_spec, distill_draft
 
 __all__ = ["model", "TransformerLMSpec", "build_symbol", "init_params",
-           "DecodePredictor", "DecodeBatcher", "StreamFuture"]
+           "DecodePredictor", "DecodeBatcher", "StreamFuture",
+           "SpecDecodePredictor", "make_draft_spec", "distill_draft"]

@@ -20,6 +20,20 @@ flight, the first queued prompt lingers for company so a cold burst
 prefills together, while joins next to running generations are
 immediate (lingering would stall live streams).
 
+Round 21 grows two orthogonal axes on the same loop. SPECULATIVE
+stepping: with a ``SpecDecodePredictor`` the per-iteration advance is
+``spec_step`` — up to k+1 bit-identical tokens per lane per launch —
+and ``submit(..., speculative=False)`` pins individual lanes to plain
+semantics (they ride the same verify launch width-1). ROLES
+(disaggregated prefill/decode): a ``role="prefill"`` batcher fills a
+lane, streams token #1, then hands the KV lane to a decode replica
+(``set_handoff`` / ``adopt``) so a long prompt's prefill never sits
+between another stream's tokens; the ``kv_handoff`` fault site loses
+the transfer mid-flight, in which case the adopting replica
+RE-PREFILLS from the prompt — deterministic prefill makes the
+recovery invisible (zero dropped, zero duplicated tokens). A declined
+handoff decodes locally: role is policy, capability stays full.
+
 Streaming: ``submit`` returns a :class:`StreamFuture` — iterate it for
 tokens as they decode; ``result()`` blocks for the whole stream.
 ``stop(drain=True)`` runs every in-flight generation to completion;
@@ -146,19 +160,37 @@ class StreamFuture:
 
 class _GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "stop_token", "future",
-                 "deadline", "t_submit", "trace_id", "span_id", "rows")
+                 "deadline", "t_submit", "trace_id", "span_id", "rows",
+                 "speculative")
 
     def __init__(self, prompt, max_new_tokens, stop_token, future,
-                 deadline):
+                 deadline, speculative=True):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.stop_token = stop_token
         self.future = future
         self.deadline = deadline
         self.rows = 1                      # base-class shed-event contract
+        self.speculative = bool(speculative)
         self.trace_id = future.trace_id = _trace.new_trace_id()
         self.span_id = _trace.new_span_id()
         self.t_submit = time.perf_counter()
+
+
+class _Adoption:
+    """One KV-lane arriving from a prefill replica (disaggregated
+    serving): the request plus its already-streamed progress and the
+    exported lane — or ``lane=None`` when the transfer was lost
+    mid-handoff, in which case the adopting side re-prefills."""
+
+    __slots__ = ("req", "last", "produced", "lane", "t0")
+
+    def __init__(self, req, last, produced, lane, t0):
+        self.req = req
+        self.last = last
+        self.produced = produced
+        self.lane = lane
+        self.t0 = t0
 
 
 class _Gen:
@@ -195,18 +227,45 @@ class DecodeBatcher(DynamicBatcher):
         Queued-REQUEST bound for admission (default
         MXTPU_DECODE_MAX_QUEUE).
     name : str
+    role : str
+        ``"unified"`` (default — prefill AND decode locally),
+        ``"prefill"`` (fill KV lanes, then hand each one to a decode
+        replica through ``set_handoff``; falls back to local decode
+        when no decode replica takes it — never a dropped stream), or
+        ``"decode"`` (adopts handed-off lanes via :meth:`adopt`;
+        direct ``submit`` still works). Role is routing POLICY — every
+        role retains full capability.
+    speculative : bool, optional
+        Advance in-flight lanes through the predictor's speculative
+        ``spec_step`` (draft + multi-token verify) instead of one
+        plain decode per step. Defaults to True exactly when the
+        predictor exposes ``spec_step`` (a ``SpecDecodePredictor``).
+        Streams stay bit-identical either way; per-request
+        ``submit(..., speculative=False)`` opts a single lane out
+        (mixed lanes ride the same verify launch with width-1 feeds).
     """
 
     def __init__(self, predictor, max_wait_us=None, max_queue=None,
-                 name="decode"):
+                 name="decode", role="unified", speculative=None):
         if max_wait_us is None:
             max_wait_us = int(config.get("MXTPU_DECODE_MAX_WAIT_US",
                                          2000))
         if max_queue is None:
             max_queue = int(config.get("MXTPU_DECODE_MAX_QUEUE", 256))
+        if role not in ("unified", "prefill", "decode"):
+            raise MXNetError(
+                f"role={role!r} must be unified|prefill|decode")
         super().__init__(predictor, max_batch=predictor.slots,
                          max_wait_us=max_wait_us, max_queue=max_queue,
                          name=name)
+        self.role = role
+        if speculative is None:
+            speculative = hasattr(predictor, "spec_step")
+        elif speculative and not hasattr(predictor, "spec_step"):
+            raise MXNetError(
+                "speculative=True needs a SpecDecodePredictor "
+                "(predictor has no spec_step)")
+        self.speculative = bool(speculative)
         self._decode_task = self._domain.new_task(f"{name}::decode")
         from ...telemetry import registry as treg
         pid = predictor.telemetry_id
@@ -214,14 +273,21 @@ class DecodeBatcher(DynamicBatcher):
         self._itl_hist = treg.histogram(
             f"serving::{pid}::inter_token_ms")
         self._gens_c = treg.counter(f"serving::{pid}::generations")
+        self._handoff_hist = treg.histogram(
+            f"serving::{pid}::handoff_ms")
         self._inflight = {}                # slot -> _Gen (under _lock)
+        self._adopt_q = []                 # _Adoption list (under _cond)
+        self._handoff_fn = None
+        self._handoffs = 0
+        self._handoff_failures = 0
+        self._adopted = 0
         self._cancel_requested = False
         self._cancelled = 0
         self._streamed_tokens = 0
 
     # -- client surface -------------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, stop_token=None,
-               deadline_ms=None):
+               deadline_ms=None, speculative=True):
         """Enqueue one generation; returns a :class:`StreamFuture`.
 
         ``prompt``: 1-D int token sequence (<= the spec's max_seq).
@@ -229,14 +295,18 @@ class DecodeBatcher(DynamicBatcher):
         and is clamped to the cache capacity
         (``DecodePredictor.gen_limit``); ``stop_token`` ends the stream
         after being yielded. ``deadline_ms`` bounds QUEUE time only —
-        a generation that started always streams to completion."""
+        a generation that started always streams to completion.
+        ``speculative=False`` pins this lane to plain-decode semantics
+        even on a speculative batcher (it rides the same verify launch
+        with a width-1 feed — the output is identical regardless; this
+        is a latency/bytes policy knob, not a correctness one)."""
         prompt = self.predictor.check_prompt(prompt)
         self.predictor.bucket_for(prompt.shape[0])  # validates length
         future = StreamFuture()
         deadline = time.perf_counter() + deadline_ms / 1e3 \
             if deadline_ms is not None else None
         req = _GenRequest(prompt, max_new_tokens, stop_token, future,
-                          deadline)
+                          deadline, speculative=speculative)
         with self._cond:
             if not self._running:
                 raise MXNetError(
@@ -264,6 +334,115 @@ class DecodeBatcher(DynamicBatcher):
                                 stop_token=stop_token,
                                 deadline_ms=deadline_ms))
 
+    # -- disaggregated prefill/decode (round 21) ------------------------------
+    def set_handoff(self, fn):
+        """Install the prefill-role handoff sink:
+        ``fn(req, last, produced, lane, t0) -> bool`` — the FleetRouter
+        wires this to a decode replica's :meth:`adopt`. ``lane`` is
+        ``export_lane``'s dict, or None when the transfer was lost
+        (``kv_handoff`` fault) — the sink must still place the request
+        so the decode side re-prefills. Returning False (or raising)
+        keeps the generation HERE: local decode is always the
+        fallback, zero dropped streams."""
+        self._handoff_fn = fn
+
+    def adopt(self, req, last, produced, lane, t0=None):
+        """Take over a generation whose KV lane a prefill replica just
+        filled (the decode side of the handoff). The lane lands in a
+        free local slot at the next poll; ``lane=None`` re-prefills
+        from the request's prompt (prefill is deterministic, so the
+        recomputed token #1 equals the one already streamed — it is
+        suppressed, not re-pushed)."""
+        with self._cond:
+            if not self._running:
+                raise MXNetError(
+                    f"DecodeBatcher '{self.name}' is not started")
+            self._adopt_q.append(_Adoption(req, last, produced, lane,
+                                           t0))
+            self._cond.notify_all()
+        return req.future
+
+    def _handoff_gen(self, g):
+        """Prefill-role epilogue for one freshly filled lane: export it
+        and offer it to the handoff sink. Consults the ``kv_handoff``
+        fault site — a fire loses the exported rows mid-transfer (the
+        sink receives ``lane=None`` and the decode side re-prefills);
+        ``action=kill`` dies outright. A sink that declines leaves the
+        generation decoding locally."""
+        from ... import faultinject
+        t0 = time.perf_counter()
+        lane = None
+        try:
+            if faultinject.fire("kv_handoff", slot=g.slot):
+                raise faultinject.FaultInjected("kv_handoff",
+                                                slot=g.slot)
+            lane = self.predictor.export_lane(g.slot)
+        except Exception:                    # noqa: BLE001
+            lane = None
+        ok = False
+        try:
+            ok = bool(self._handoff_fn(g.req, g.last, g.produced, lane,
+                                       t0))
+        except Exception:                    # noqa: BLE001
+            ok = False
+        if ok:
+            self.predictor.release(g.slot)
+            with self._lock:
+                self._handoffs += 1
+        else:
+            with self._lock:
+                self._handoff_failures += 1
+                self._inflight[g.slot] = g
+
+    def _start_adopted(self, a, slot):
+        """Land one adopted lane (outside the queue lock): import the
+        exported rows, or RE-PREFILL from the prompt when the handoff
+        was lost — bit-identity makes the recovery invisible."""
+        req = a.req
+        plen = req.prompt.shape[0]
+        bucket = self.predictor.bucket_for(plen)
+        limit = self.predictor.gen_limit(plen, req.max_new_tokens)
+        landed = False
+        if a.lane is not None:
+            try:
+                with _trace.span(
+                        "decode:lane_import", cat="serving",
+                        trace=req.trace_id,
+                        args={"batcher": self.telemetry_id,
+                              "bytes": a.lane.get("bytes")}):
+                    self.predictor.import_lane(slot, a.lane,
+                                               prompt=req.prompt)
+                landed = True
+            except Exception:                # noqa: BLE001
+                landed = False
+        if not landed:
+            try:
+                with _trace.span(
+                        "decode:reprefill", cat="serving",
+                        trace=req.trace_id,
+                        args={"batcher": self.telemetry_id,
+                              "bucket": bucket}), \
+                        self._tasks[bucket]:
+                    self.predictor.prefill(slot, req.prompt)
+            except Exception as e:           # noqa: BLE001
+                self.predictor.release(slot)
+                req.future._finish(error=e)
+                return
+        now = time.perf_counter()
+        if a.t0 is not None:
+            self._handoff_hist.observe((now - a.t0) * 1e3)
+        g = _Gen(req, slot, bucket, limit)
+        g.last = a.last
+        g.produced = a.produced
+        g.t_first = g.t_last = now
+        with self._lock:
+            self._adopted += 1
+        if g.finished():
+            self._complete_gen(g)
+        else:
+            with self._lock:
+                self._inflight[slot] = g
+
     # -- stop() contract ------------------------------------------------------
     def _cancel_inflight(self):
         # called under the queue lock by stop(drain=False): mark the
@@ -285,23 +464,35 @@ class DecodeBatcher(DynamicBatcher):
 
     def _poll(self):
         """Admission decisions under the queue lock. Returns
-        ``(admitted, expired)`` — ``admitted`` as ``(req, slot)`` pairs
-        with lanes pre-claimed — or ``None`` at clean exit."""
+        ``(admitted, expired, adopted)`` — ``admitted`` as ``(req,
+        slot)`` pairs with lanes pre-claimed, ``adopted`` as
+        ``(_Adoption, slot)`` pairs (handed-off lanes claim slots
+        FIRST: they already hold a live stream) — or ``None`` at clean
+        exit."""
         max_wait_s = self.max_wait_us / 1e6
         with self._cond:
             while self._running and not self._queue and \
-                    not self._inflight and not self._cancel_requested:
+                    not self._inflight and not self._adopt_q and \
+                    not self._cancel_requested:
                 self._cond.wait(timeout=0.1)
             if self._cancel_requested:
-                return [], []
-            if not self._queue and not self._inflight:
+                return [], [], []
+            if not self._queue and not self._inflight and \
+                    not self._adopt_q:
                 return None                         # stopped + drained
-            if self._queue and not self._inflight and self._running:
+            adopted = []
+            while self._adopt_q:
+                slot = self.predictor.alloc_slot()
+                if slot is None:
+                    break                           # lanes saturated
+                adopted.append((self._adopt_q.pop(0), slot))
+            if self._queue and not self._inflight and not adopted \
+                    and self._running:
                 # first-fill linger: a cold burst is worth batching the
                 # prefills; deadlines cap the linger exactly like the
                 # whole-request batcher's window
                 t_first = self._queue[0].t_submit
-                while self._running and \
+                while self._running and not self._adopt_q and \
                         len(self._queue) < self.predictor.slots:
                     launch_at = t_first + max_wait_s
                     for r in self._queue:
@@ -333,7 +524,7 @@ class DecodeBatcher(DynamicBatcher):
                 self._queue.pop(0)
                 self._queued_rows -= 1
                 admitted.append((r, slot))
-        return admitted, expired
+        return admitted, expired, adopted
 
     def _emit_expired(self, expired):
         from ...telemetry import export as _texp
@@ -380,32 +571,46 @@ class DecodeBatcher(DynamicBatcher):
             self._streamed_tokens += 1
         if g.finished():
             self._complete_gen(g)
+        elif self.role == "prefill" and self._handoff_fn is not None:
+            self._handoff_gen(g)
         else:
             with self._lock:
                 self._inflight[slot] = g
 
     def _step(self):
-        """Advance every in-flight generation ONE token; retire finished
-        lanes (their slots backfill on the next poll). A failed decode
-        program fails the generations that were in it — the serving
-        loop itself survives."""
+        """Advance every in-flight generation — ONE token via the plain
+        decode program, or up to k+1 via the speculative round
+        (``spec_step``: identical tokens, fewer launches); retire
+        finished lanes (their slots backfill on the next poll). A
+        failed program fails the generations that were in it — the
+        serving loop itself survives."""
         with self._lock:
             active = dict(self._inflight)
         if not active:
             return
-        mapping = {slot: g.last for slot, g in active.items()}
         try:
             with _trace.span(
                     "decode:step", cat="serving",
                     args={"batcher": self.telemetry_id,
-                          "lanes": len(mapping),
+                          "lanes": len(active),
+                          "speculative": self.speculative,
                           "trace_ids": [g.req.trace_id
                                         for g in active.values()]}), \
                     self._decode_task:
-                out = self.predictor.decode(mapping)
+                if self.speculative:
+                    out = self.predictor.spec_step(
+                        {slot: (g.last, g.limit - g.produced,
+                                g.req.speculative)
+                         for slot, g in active.items()})
+                else:
+                    out = {slot: [tok] for slot, tok in
+                           self.predictor.decode(
+                               {slot: g.last
+                                for slot, g in active.items()}
+                           ).items()}
         except Exception as e:                       # noqa: BLE001
             with self._lock:
-                for slot in mapping:
+                for slot in active:
                     self._inflight.pop(slot, None)
             for slot, g in active.items():
                 self.predictor.release(slot)
@@ -413,18 +618,26 @@ class DecodeBatcher(DynamicBatcher):
             return
         now = time.perf_counter()
         finished = []
+        pushes = []
         with self._lock:
             for slot, g in active.items():
-                g.last = out[slot]
-                g.produced += 1
-                self._itl_hist.observe((now - g.t_last) * 1e3)
-                g.t_last = now
-                self._streamed_tokens += 1
+                # a speculative round may overshoot a stop_token:
+                # consume committed tokens only up to the finish (the
+                # stream must end exactly where solo greedy ends)
+                for tok in out[slot]:
+                    g.last = tok
+                    g.produced += 1
+                    self._itl_hist.observe((now - g.t_last) * 1e3)
+                    g.t_last = now
+                    self._streamed_tokens += 1
+                    pushes.append((g.req.future, tok))
+                    if g.finished():
+                        break
                 if g.finished():
                     self._inflight.pop(slot, None)
                     finished.append(g)
-        for slot, g in active.items():
-            g.req.future._push(g.last)
+        for fut, tok in pushes:
+            fut._push(tok)
         for g in finished:
             self._complete_gen(g)
 
@@ -469,8 +682,10 @@ class DecodeBatcher(DynamicBatcher):
                 work = self._poll()
                 if work is None:
                     return
-                admitted, expired = work
+                admitted, expired, adopted = work
                 self._emit_expired(expired)
+                for a, slot in adopted:
+                    self._start_adopted(a, slot)
                 for r, slot in admitted:
                     self._start_gen(r, slot)
                 self._step()
@@ -484,7 +699,13 @@ class DecodeBatcher(DynamicBatcher):
                 queued = list(self._queue)
                 self._queue.clear()
                 self._queued_rows = 0
+                orphaned = list(self._adopt_q)
+                self._adopt_q.clear()
                 self._cancel_requested = False
+            for a in orphaned:
+                a.req.future._finish(error=Cancelled(
+                    f"serving loop exited with the adopted lane "
+                    f"unlanded after {a.produced} tokens"))
             for g in victims:
                 self.predictor.release(g.slot)
                 with self._lock:
@@ -512,6 +733,7 @@ class DecodeBatcher(DynamicBatcher):
 
         ttft = _snap(self._ttft_hist)
         itl = _snap(self._itl_hist)
+        handoff = _snap(self._handoff_hist)
         with self._lock:
             per_bucket = {}
             for b in self.predictor.buckets:
@@ -541,6 +763,13 @@ class DecodeBatcher(DynamicBatcher):
                 "inter_token_p50_ms": itl.get("p50"),
                 "inter_token_p99_ms": itl.get("p99"),
                 "per_bucket": per_bucket,
+                "role": self.role,
+                "speculative": self.speculative,
+                "handoffs": self._handoffs,
+                "handoff_failures": self._handoff_failures,
+                "adopted": self._adopted,
+                "handoff_p50_ms": handoff.get("p50"),
+                "handoff_p99_ms": handoff.get("p99"),
             }
             if reset:
                 self._served = 0
@@ -548,4 +777,7 @@ class DecodeBatcher(DynamicBatcher):
                 self._deadline_missed = 0
                 self._cancelled = 0
                 self._streamed_tokens = 0
+                self._handoffs = 0
+                self._handoff_failures = 0
+                self._adopted = 0
         return out

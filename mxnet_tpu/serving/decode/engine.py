@@ -10,7 +10,12 @@ program families iterative decode needs (model.py):
   saturated, which is half of the bit-identity guarantee;
 - ONE DECODE program — advances all ``slots`` lanes a single token
   against the cache. Lanes are data-independent, so a lane's output
-  doesn't depend on which other slots are occupied: the other half.
+  doesn't depend on which other slots are occupied: the other half;
+- per-width VERIFY programs (round 21, ``verify_widths``) — advance
+  all lanes up to K tokens in one launch for speculative decoding
+  (model.verify_step); width is compile-key material like the prefill
+  buckets, and warmup materializes every declared width so serving
+  performs zero fresh verify traces.
 
 The KV-cache is DONATED device state: ``2 * num_layers`` buffers of
 ``(slots, max_seq, heads, head_dim)`` float32 — or, under
@@ -154,6 +159,13 @@ class DecodePredictor:
                                       positions, active,
                                       kv_dtype=kv_dtype_s)
 
+        def verify_fn(pvals_t, caches, tokens, positions, n_tokens,
+                      active):
+            p = dict(zip(pnames, pvals_t))
+            return _model.verify_step(spec, p, caches, tokens,
+                                      positions, n_tokens, active,
+                                      kv_dtype=kv_dtype_s)
+
         def reprefill_fn(pvals_t, tokens, length):
             p = dict(zip(pnames, pvals_t))
             return _model.reprefill_step(spec, p, tokens, length)
@@ -163,7 +175,12 @@ class DecodePredictor:
         self._donate = bool(donate)
         self._prefill_jit = jax.jit(prefill_fn, **donate)
         self._decode_jit = jax.jit(decode_fn, **donate)
+        self._verify_jit = jax.jit(verify_fn, **donate)
         self._reprefill_jit = jax.jit(reprefill_fn)
+        # multi-token verify widths warmup should materialize; empty on
+        # a plain engine (verify still compiles lazily at any width the
+        # caller asks for), set by SpecDecodePredictor to (k+1,)
+        self.verify_widths = ()
 
         self._lock = threading.RLock()
         self._programs = {}       # ("prefill", b) / ("decode",) / ...
@@ -174,6 +191,7 @@ class DecodePredictor:
         self._free = list(range(self.slots))      # LIFO slot allocator
         self._slot_pos = [0] * self.slots         # next write position
         self._decode_steps = 0
+        self._verify_steps = 0
         self._prefills = 0
         self._tokens = 0
 
@@ -258,11 +276,18 @@ class DecodePredictor:
             "cache_layout": layout if kind != "reprefill" else "none",
             "donate": self._donate and kind != "reprefill",
         })
-        sigs = ((("tokens", (1, bucket), "int32"),)
-                if bucket is not None
-                else (("tokens", (self.slots,), "int32"),))
-        label = f"decode:{self.name}:{kind}" + \
-            (f":s{bucket}" if bucket is not None else "")
+        if kind == "verify":
+            # ``bucket`` is the verify WIDTH (max fed tokens per lane):
+            # width is program-shape material exactly like a prefill's
+            # seq bucket, so each width is its own registry entry
+            sigs = (("tokens", (self.slots, bucket), "int32"),)
+            label = f"decode:{self.name}:verify:k{bucket}"
+        else:
+            sigs = ((("tokens", (1, bucket), "int32"),)
+                    if bucket is not None
+                    else (("tokens", (self.slots,), "int32"),))
+            label = f"decode:{self.name}:{kind}" + \
+                (f":s{bucket}" if bucket is not None else "")
         return compile_mod.program_key(
             "decode", label, input_sigs=sigs, extra=extra)
 
@@ -342,6 +367,24 @@ class DecodePredictor:
         with self._lock:
             return len(self._free)
 
+    def slot_pos(self, slot):
+        """A lane's committed write position (next row index)."""
+        with self._lock:
+            return self._slot_pos[slot]
+
+    def seek_slot(self, slot, pos):
+        """Set a lane's committed position explicitly. The speculative
+        layer commits an accepted prefix through here (``verify`` never
+        advances positions itself — rows written for rejected drafts
+        simply go stale behind the new position), and a KV-lane import
+        lands its transferred position the same way."""
+        if not 0 <= int(pos) <= self.spec.max_seq:
+            raise MXNetError(
+                f"seek_slot position {pos} outside [0, "
+                f"{self.spec.max_seq}]")
+        with self._lock:
+            self._slot_pos[slot] = int(pos)
+
     # -- execution ------------------------------------------------------------
     def prefill(self, slot, prompt):
         """Fill ``slot`` from a validated prompt; returns token #1."""
@@ -375,8 +418,13 @@ class DecodePredictor:
         with self._lock:
             ordinal = self._decode_steps + 1
             if faultinject.fire("decode_step", token=ordinal):
-                raise faultinject.FaultInjected("decode_step",
-                                                token=ordinal)
+                armed = faultinject.active("decode_step") or {}
+                if armed.get("action") != "sleep":
+                    raise faultinject.FaultInjected("decode_step",
+                                                    token=ordinal)
+                # sleep-armed: the slow-decode straggler drill (mirrors
+                # replica_drop's sleep semantics) — fire() already
+                # stretched this step, the program still runs
             tokens = np.zeros(self.slots, np.int32)
             positions = np.zeros(self.slots, np.int32)
             active = np.zeros(self.slots, bool)
@@ -396,6 +444,103 @@ class DecodePredictor:
             self._tokens += len(slot_tokens)
         self._tokens_c.inc(len(slot_tokens))
         return {slot: int(nxt[slot]) for slot in slot_tokens}
+
+    def _verify_width_for(self, n):
+        for w in self.verify_widths:
+            if n <= w:
+                return w
+        return n
+
+    def verify(self, slot_feed):
+        """One multi-token verify step: ``{slot: fed_tokens}`` — each
+        lane's fed list is its last COMMITTED token followed by draft
+        proposals — to ``{slot: np.int32 array}`` of the target's
+        argmax after each fed token. Pads every lane to the smallest
+        declared ``verify_widths`` bucket that fits (padding writes
+        nowhere). Positions are NOT advanced: the caller decides the
+        accepted prefix and commits it via ``seek_slot`` — which is
+        what keeps a rejected draft's cache rows harmlessly stale
+        instead of corrupting the lane."""
+        if not slot_feed:
+            return {}
+        counts = {s: len(f) for s, f in slot_feed.items()}
+        if min(counts.values()) < 1:
+            raise MXNetError("verify needs at least the committed "
+                             "token per lane")
+        width = self._verify_width_for(max(counts.values()))
+        with self._lock:
+            tokens = np.zeros((self.slots, width), np.int32)
+            positions = np.zeros(self.slots, np.int32)
+            n_tok = np.ones(self.slots, np.int32)
+            active = np.zeros(self.slots, bool)
+            for slot, fed in slot_feed.items():
+                n = counts[slot]
+                tokens[slot, :n] = fed
+                positions[slot] = self._slot_pos[slot]
+                n_tok[slot] = n
+                active[slot] = True
+            args = (self._pvals_t, self._caches, tokens, positions,
+                    n_tok, active)
+            new_caches, outs = self._run(
+                ("verify", width), "verify", width, self._verify_jit,
+                args)
+            self._caches = tuple(new_caches)
+            outs = np.asarray(outs)
+            self._verify_steps += 1
+        return {slot: outs[slot, :counts[slot]].copy()
+                for slot in slot_feed}
+
+    # -- KV-lane handoff (disaggregated prefill/decode, round 21) -------------
+    def lane_fingerprint(self):
+        """Layout key a lane must match to transfer between engines:
+        spec material + cache layout. Slots COUNT is deliberately not
+        part of it — a prefill replica with 4 lanes hands off to a
+        decode replica with 16."""
+        layout = ("slot-major:int8+f32scale" if self.kv_dtype == "int8"
+                  else "slot-major:f32")
+        return dict(self.spec.key_material(), cache_layout=layout)
+
+    def export_lane(self, slot):
+        """Snapshot one lane's cache rows + committed position as a
+        host-transportable dict — the prefill side of the KV-lane
+        handoff. Under int8 KV the rows are the QUANTIZED buffers, so
+        the handoff moves ~0.31× the f32 bytes (the r19 capacity lever
+        doubling as a transfer-bytes lever)."""
+        with self._lock:
+            rows = [np.asarray(c[slot]) for c in self._caches]
+            pos = self._slot_pos[slot]
+        return {
+            "fingerprint": self.lane_fingerprint(),
+            "pos": int(pos),
+            "rows": rows,
+            "bytes": int(sum(r.nbytes for r in rows)),
+        }
+
+    def import_lane(self, slot, lane, prompt=None):
+        """Land an exported lane into a free local slot — the decode
+        side of the handoff. Refuses a fingerprint mismatch (two specs
+        or two cache layouts must never silently mix rows). ``prompt``
+        (the lane's committed tokens) is unused here but part of the
+        contract: subclasses with auxiliary per-lane state — the
+        speculative predictor's DRAFT cache — rebuild it from the
+        prompt on import."""
+        if lane["fingerprint"] != self.lane_fingerprint():
+            raise MXNetError(
+                f"KV-lane fingerprint mismatch: exporter "
+                f"{lane['fingerprint']} vs importer "
+                f"{self.lane_fingerprint()} — handoff requires "
+                "identical spec + cache layout")
+        import jax.numpy as jnp
+        rows = lane["rows"]
+        with self._lock:
+            if len(rows) != len(self._caches):
+                raise MXNetError(
+                    f"KV-lane has {len(rows)} buffers, cache has "
+                    f"{len(self._caches)}")
+            self._caches = tuple(
+                c.at[slot].set(jnp.asarray(r))
+                for c, r in zip(self._caches, rows))
+            self._slot_pos[slot] = int(lane["pos"])
 
     def generate(self, prompt, max_new_tokens=None, stop_token=None):
         """Stream tokens for ONE prompt (a generator): the solo surface
@@ -449,6 +594,17 @@ class DecodePredictor:
                 new_caches, _ = self._run(
                     ("decode",), "decode", None, self._decode_jit, args)
                 self._caches = tuple(new_caches)
+            for w in self.verify_widths:
+                if ("verify", w) not in self._programs:
+                    args = (self._pvals_t, self._caches,
+                            np.zeros((self.slots, w), np.int32),
+                            np.zeros(self.slots, np.int32),
+                            np.ones(self.slots, np.int32),
+                            np.zeros(self.slots, bool))
+                    new_caches, _ = self._run(
+                        ("verify", w), "verify", w, self._verify_jit,
+                        args)
+                    self._caches = tuple(new_caches)
         return self.retraces
 
     # -- measured-gate surfaces ----------------------------------------------
@@ -506,6 +662,7 @@ class DecodePredictor:
                 "compile_cache_loads": self._cache_loads,
                 "prefills": self._prefills,
                 "decode_steps": self._decode_steps,
+                "verify_steps": self._verify_steps,
                 "tokens": self._tokens,
                 "kv_dtype": self.kv_dtype,
                 "kv_cache_bytes": self.kv_cache_bytes(),
@@ -520,5 +677,6 @@ class DecodePredictor:
             if reset:
                 self._prefills = 0
                 self._decode_steps = 0
+                self._verify_steps = 0
                 self._tokens = 0
         return out

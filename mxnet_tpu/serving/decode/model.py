@@ -399,6 +399,82 @@ def decode_step(spec, p, caches, tokens, positions, active,
     return tuple(new_caches), nxt
 
 
+def verify_step(spec, p, caches, tokens, positions, n_tokens, active,
+                kv_dtype="float32"):
+    """Advance every active slot by UP TO K tokens in ONE program — the
+    speculative-decoding verify step (round 21).
+
+    tokens: (slots, K) int32 — token j of slot s feeds at position
+    ``positions[s] + j``; token 0 is the slot's last COMMITTED token,
+    tokens 1.. are draft proposals. positions: (slots,) int32 base
+    position (== the committed write index, exactly what a plain decode
+    step would feed). n_tokens: (slots,) int32 in [1, K] — tokens this
+    slot actually feeds; the tail is padding that writes nowhere (the
+    same ``max_seq`` drop-sentinel as inactive decode lanes). active:
+    (slots,) bool. Returns ``(caches', out (slots, K) int32)`` where
+    ``out[s, j]`` is the target's greedy argmax for the position AFTER
+    fed token j — ``out[s, 0]`` is bit-for-bit what ``decode_step``
+    would have emitted, and ``out[s, j]`` is the continuation GIVEN the
+    fed prefix, which is why accept-prefix semantics (engine/spec.py)
+    keep the stream identical to solo greedy decode.
+
+    Token j's attention sees cache rows ``<= positions[s] + j``: the
+    rows this step just scattered for tokens 0..j (the in-step causal
+    prefix) plus every committed row, stale rows beyond masked to an
+    exact-0 contribution by the same ``-1e30`` convention as decode.
+    Rows written for REJECTED drafts are stale the moment the caller
+    commits a shorter prefix — the next feed overwrites the first of
+    them and masks the rest, so no rollback pass is ever needed. Lanes
+    stay data-independent (per-lane rows, per-lane scales under int8):
+    mixed speculative/plain batches cannot perturb each other, which is
+    what lets plain lanes ride the same verify program at n_tokens=1.
+    """
+    import jax.numpy as jnp
+
+    int8_kv = check_kv_dtype(kv_dtype) == "int8"
+    n, kk = tokens.shape
+    scale = 1.0 / (spec.head_dim ** 0.5)
+    sidx = jnp.arange(n)
+    j = jnp.arange(kk)
+    fed = active[:, None] & (j[None, :] < n_tokens[:, None])   # (n, K)
+    pos = positions[:, None] + j[None, :]                      # (n, K)
+    safe_pos = jnp.where(fed, pos, 0)
+    wpos = jnp.where(fed, pos, spec.max_seq)       # OOB => dropped
+    x = p["tok_emb_weight"][tokens] + p["pos_emb_weight"][safe_pos]
+    visible = jnp.arange(spec.max_seq)[None, None, :] <= pos[:, :, None]
+    new_caches = []
+    for i in range(spec.num_layers):
+        h = _ln(x, p[f"l{i}_ln1_gamma"], p[f"l{i}_ln1_beta"])
+        qkv = h @ p[f"l{i}_qkv_weight"].T
+        q, k, v = _split_qkv(qkv, spec.num_heads, spec.head_dim)
+        if int8_kv:
+            kq, ks, vq, vs = caches[4 * i: 4 * i + 4]
+            kqi, ksc = _kv_quant_rows(k)
+            vqi, vsc = _kv_quant_rows(v)
+            kq = kq.at[sidx[:, None], wpos].set(kqi, mode="drop")
+            ks = ks.at[sidx[:, None], wpos].set(ksc, mode="drop")
+            vq = vq.at[sidx[:, None], wpos].set(vqi, mode="drop")
+            vs = vs.at[sidx[:, None], wpos].set(vsc, mode="drop")
+            new_caches += [kq, ks, vq, vs]
+            kc = _kv_dequant(kq, ks)
+            vc = _kv_dequant(vq, vs)
+        else:
+            kc = caches[2 * i].at[sidx[:, None], wpos].set(
+                k.astype(caches[2 * i].dtype), mode="drop")
+            vc = caches[2 * i + 1].at[sidx[:, None], wpos].set(
+                v.astype(caches[2 * i + 1].dtype), mode="drop")
+            new_caches += [kc, vc]
+        s = jnp.einsum("nkhd,nmhd->nkhm", q, kc) * scale
+        s = jnp.where(visible[:, :, None, :], s, _NEG)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        w = jnp.exp(s - m)
+        o = jnp.einsum("nkhm,nmhd->nkhd", w, vc)
+        o = o / jnp.sum(w, axis=-1)[..., None]
+        x = _block_tail(spec, p, i, x, o.reshape(n, kk, -1))
+    nxt, _ = _head(spec, p, x)
+    return tuple(new_caches), nxt
+
+
 def reprefill_step(spec, p, tokens, length):
     """The CACHELESS baseline: recompute the whole prompt forward and
     emit the next token, touching no KV state — what a server without a

@@ -62,6 +62,17 @@ fleet:
   registry series EAGERLY (not at GC), so autoscale churn never grows
   ``mx.telemetry.report()``. The policy thread deciding when lives in
   serving/autoscale.py.
+Round 21 adds **replica roles** (disaggregated prefill/decode): a
+``TenantSpec`` with ``prefill_replicas``/``decode_replicas`` > 0 runs
+role-split — ``submit`` routes new generations to PREFILL replicas,
+each filled KV lane hands off to the least-loaded DECODE replica
+(``DecodeBatcher.set_handoff`` -> ``adopt``; the router wires the sink
+at spawn), replacements preserve the dead replica's role,
+``scale_up(tenant, role=...)`` grows one role group (default
+``decode``), ``scale_down`` refuses to retire the last replica of a
+role, and ``signals()`` breaks queue/capacity out per role so the
+autoscaler can grow the side that is actually behind.
+
 - **weight hot-swap** — ``swap_weights(tenant, arg_params)`` restages
   a new checkpoint's params replica-by-replica: each replica stops
   taking new work (DRAINING), serves out its queue, restages params as
@@ -96,10 +107,11 @@ class _Replica:
     router-side health ledger (consecutive failures, latency window)."""
 
     __slots__ = ("slot", "batcher", "state", "consec_failures", "lats",
-                 "served", "redispatched_away", "generation", "tenant")
+                 "served", "redispatched_away", "generation", "tenant",
+                 "role")
 
     def __init__(self, slot, batcher, generation=0,
-                 tenant=DEFAULT_TENANT):
+                 tenant=DEFAULT_TENANT, role="unified"):
         self.slot = slot
         self.batcher = batcher
         self.state = STARTING
@@ -109,6 +121,7 @@ class _Replica:
         self.redispatched_away = 0
         self.generation = generation
         self.tenant = tenant
+        self.role = role
 
     @property
     def predictor(self):
@@ -176,7 +189,7 @@ class FleetRouter:
             if spec.name in self._tenants:
                 raise MXNetError(f"duplicate tenant '{spec.name}'")
             self._tenants[spec.name] = _TenantLedger(spec)
-        self._n = sum(s.replicas for s in specs)
+        self._n = sum(s.total_replicas for s in specs)
         self.name = name
         self.probe_interval_s = float(
             probe_interval_s if probe_interval_s is not None
@@ -241,9 +254,17 @@ class FleetRouter:
                 return self
             slot = 0
             for tname, ledger in self._tenants.items():
-                for _ in range(ledger.spec.replicas):
-                    self._replicas.append(self._spawn(slot, tname))
-                    slot += 1
+                formation = \
+                    [("unified", ledger.spec.replicas),
+                     ("decode", ledger.spec.decode_replicas),
+                     ("prefill", ledger.spec.prefill_replicas)]
+                # decode replicas spawn BEFORE prefill ones: a prefill
+                # replica's first handoff must find a sink
+                for role, count in formation:
+                    for _ in range(count):
+                        self._replicas.append(
+                            self._spawn(slot, tname, role=role))
+                        slot += 1
             self._running = True
         self._probe = threading.Thread(target=self._probe_loop,
                                        name=f"{self.name}-probe",
@@ -275,16 +296,44 @@ class FleetRouter:
     def __exit__(self, *exc):
         self.stop()
 
-    def _spawn(self, slot, tenant=DEFAULT_TENANT):
+    def _spawn(self, slot, tenant=DEFAULT_TENANT, role="unified"):
         """Factory + warmup for one replica slot (replacements and
         scale-ups reuse this; the warmup retrace count is the
-        AOT-spin-up pin)."""
-        batcher = self._tenants[tenant].spec.factory()
+        AOT-spin-up pin). ``role`` is forwarded to factories that
+        accept it (else set as an attribute); prefill replicas get the
+        router-wired handoff sink BEFORE starting, so their very first
+        lane has a decode replica to land on."""
+        factory = self._tenants[tenant].spec.factory
+        if role == "unified":
+            batcher = factory()
+        else:
+            try:
+                batcher = factory(role=role)
+            except TypeError:
+                batcher = factory()
+                batcher.role = role
+        if role == "prefill" and hasattr(batcher, "set_handoff"):
+            batcher.set_handoff(self._make_handoff(tenant))
         batcher.start()
         rep = _Replica(slot, batcher, generation=self._gen,
-                       tenant=tenant)
+                       tenant=tenant, role=role)
         rep.state = HEALTHY
         return rep
+
+    def _make_handoff(self, tenant):
+        """The prefill->decode KV-lane sink for one tenant group:
+        least-loaded healthy decode replica adopts the lane. Returns
+        False when none is up — the prefill replica then decodes
+        locally (role is policy; zero dropped streams)."""
+        def _handoff(req, last, produced, lane, t0):
+            for rep in self._candidates(tenant, role="decode"):
+                try:
+                    rep.batcher.adopt(req, last, produced, lane, t0)
+                    return True
+                except Exception:        # noqa: BLE001 — next candidate
+                    continue
+            return False
+        return _handoff
 
     def _live(self):
         """Snapshot of occupied slots (scale-down leaves None holes)."""
@@ -381,11 +430,12 @@ class FleetRouter:
                            tenant=tenant, **kw).result(timeout)
 
     # -- dispatch / re-dispatch ----------------------------------------------
-    def _candidates(self, tenant=None):
+    def _candidates(self, tenant=None, role=None):
         with self._lock:
             reps = [r for r in self._replicas
                     if r is not None and r.state == HEALTHY
-                    and (tenant is None or r.tenant == tenant)]
+                    and (tenant is None or r.tenant == tenant)
+                    and (role is None or r.role == role)]
         return sorted(reps, key=lambda r: r.queue_depth())
 
     def _dispatch(self, data, deadline, deadline_ms, kw, attempt, outer,
@@ -398,7 +448,16 @@ class FleetRouter:
         if deadline is not None:
             remaining_ms = max(0.0,
                                (deadline - time.perf_counter()) * 1e3)
-        for rep in self._candidates(ledger.spec.name):
+        if ledger.spec.disaggregated:
+            # new generations enter through the PREFILL side; decode
+            # replicas receive lanes via handoff, not submits. With no
+            # prefill replica up (mid-replace window), any healthy
+            # replica serves — availability over formation purity.
+            reps = self._candidates(ledger.spec.name, role="prefill") \
+                or self._candidates(ledger.spec.name)
+        else:
+            reps = self._candidates(ledger.spec.name)
+        for rep in reps:
             try:
                 inner = rep.batcher.submit(data,
                                            deadline_ms=remaining_ms,
@@ -688,7 +747,7 @@ class FleetRouter:
             self._gen += 1
             gen = self._gen
         try:
-            fresh = self._spawn(rep.slot, rep.tenant)
+            fresh = self._spawn(rep.slot, rep.tenant, role=rep.role)
         except Exception:                # noqa: BLE001 — retry next probe
             import logging
             logging.getLogger("mxnet_tpu.serving").exception(
@@ -726,16 +785,24 @@ class FleetRouter:
         return self._last_drain_s
 
     # -- elastic slots (serving/autoscale.py drives these) --------------------
-    def scale_up(self, tenant=None):
+    def scale_up(self, tenant=None, role=None):
         """Spin one more replica into ``tenant``'s group (a vacant
         slot is reused, else the fleet grows a slot). The spin-up is
         an AOT load from the shared compile cache — the fresh-trace
         count is recorded in ``spinup_retraces`` and pinned at 0 by
         the drills. The ``scale_up`` fault site fires before the
         factory runs (the failed/hung-provision drill); a raise leaves
-        the slot vacant for the autoscaler's backoff retry. Returns
-        the new slot index."""
+        the slot vacant for the autoscaler's backoff retry.
+        ``role`` picks the group to grow in a disaggregated formation
+        (default: ``decode`` — the throughput side — for disaggregated
+        tenants, ``unified`` otherwise). Returns the new slot index."""
         tname = self._resolve_tenant(tenant)
+        if role is None:
+            role = "decode" if self._tenants[tname].spec.disaggregated \
+                else "unified"
+        if role not in ("unified", "prefill", "decode"):
+            raise MXNetError(
+                f"scale_up role={role!r} must be unified|prefill|decode")
         with self._lock:
             if not self._running:
                 raise MXNetError(f"FleetRouter '{self.name}' is not "
@@ -751,7 +818,7 @@ class FleetRouter:
         if faultinject.fire("scale_up", tenant=tname) and \
                 (params or {}).get("action") != "sleep":
             raise faultinject.FaultInjected("scale_up", tenant=tname)
-        fresh = self._spawn(slot, tname)
+        fresh = self._spawn(slot, tname, role=role)
         fresh.generation = gen
         with self._lock:
             self._replicas[slot] = fresh
@@ -762,7 +829,8 @@ class FleetRouter:
         if _texp.enabled():
             _texp.emit_event(
                 "fleet_scale_up", router=self.telemetry_id, slot=slot,
-                tenant=tname, replica=fresh.predictor.telemetry_id,
+                tenant=tname, role=role,
+                replica=fresh.predictor.telemetry_id,
                 retraces=fresh.predictor.retraces,
                 cache_loads=fresh.predictor._cache_loads)
         return slot
@@ -782,12 +850,27 @@ class FleetRouter:
                        and r.tenant == tname]
             if len(healthy) <= 1:
                 return None
+            role_counts = {}
+            for r in healthy:
+                role_counts[r.role] = role_counts.get(r.role, 0) + 1
+            disagg = self._tenants[tname].spec.disaggregated
+
+            def _retirable(r):
+                # a disaggregated formation keeps >= 1 of each role:
+                # retiring the last prefill (or decode) replica would
+                # silently collapse the split
+                return not disagg or r.role == "unified" or \
+                    role_counts.get(r.role, 0) >= 2
+
             if slot is None:
-                rep = min(healthy, key=lambda r: r.queue_depth())
+                eligible = [r for r in healthy if _retirable(r)]
+                if not eligible:
+                    return None
+                rep = min(eligible, key=lambda r: r.queue_depth())
             else:
                 rep = self._replicas[slot]
                 if rep is None or rep.state != HEALTHY or \
-                        rep.tenant != tname:
+                        rep.tenant != tname or not _retirable(rep):
                     return None
             rep.state = DRAINING
             self._replicas[rep.slot] = None   # vacate: no replacement
@@ -905,9 +988,18 @@ class FleetRouter:
             shed = ledger.shed
         queued = sum(r.queue_depth() for r in reps)
         capacity = sum(getattr(r.batcher, "max_batch", 1) for r in reps)
+        roles = {}
+        for r in reps:
+            d = roles.setdefault(r.role, {"healthy": 0,
+                                          "queued_rows": 0,
+                                          "capacity": 0})
+            d["healthy"] += 1
+            d["queued_rows"] += r.queue_depth()
+            d["capacity"] += getattr(r.batcher, "max_batch", 1)
         return {"tenant": tname, "healthy": len(reps),
                 "queued_rows": queued, "capacity": max(1, capacity),
-                "inflight": inflight, "shed": shed}
+                "inflight": inflight, "shed": shed, "roles": roles,
+                "disaggregated": ledger.spec.disaggregated}
 
     def tenant_report(self, reset=False):
         with self._lock:
@@ -921,10 +1013,11 @@ class FleetRouter:
                 if r is None:
                     continue
                 med = _median(r.lats)
-                per_replica.append({
+                row = {
                     "slot": r.slot,
                     "id": r.predictor.telemetry_id,
                     "tenant": r.tenant,
+                    "role": r.role,
                     "state": r.state,
                     "generation": r.generation,
                     "served": r.served,
@@ -933,7 +1026,16 @@ class FleetRouter:
                     "p50_ms": round(med * 1e3, 3) if med else None,
                     "queue_depth": r.queue_depth(),
                     "retraces": r.predictor.retraces,
-                })
+                }
+                # disaggregated decode batchers carry KV-lane handoff
+                # ledgers; surface them so role health is scrape-able
+                for attr, key in (("_handoffs", "handoffs"),
+                                  ("_handoff_failures",
+                                   "handoff_failures"),
+                                  ("_adopted", "adopted")):
+                    if hasattr(r.batcher, attr):
+                        row[key] = getattr(r.batcher, attr)
+                per_replica.append(row)
             out = {
                 "id": self.telemetry_id,
                 "name": self.name,

@@ -31,7 +31,7 @@ import numpy as np
 from . import Overloaded
 
 __all__ = ["closed_loop", "ramp", "raw_predict_rate",
-           "token_closed_loop", "client_report"]
+           "token_closed_loop", "mixed_prompts", "client_report"]
 
 # client-side retry ledger (process-wide; serving_report()'s "clients"
 # section reads it, reset=True starts a fresh window)
@@ -318,6 +318,30 @@ def ramp(batcher, x_req, profile, tenants=None, timeout=300,
     }
 
 
+def mixed_prompts(dist, vocab_size, n=None, seed=0):
+    """Build a MIXED prompt-length workload from ``dist``
+    (``{length: weight}``): ``n`` prompts (default ``sum(weights)``)
+    whose lengths follow the weighted wheel exactly — a 3:1
+    short:long distribution is exactly 3:1 across any window of
+    ``sum(weights)`` consecutive draws, not a coin flip (same
+    determinism idiom as :func:`ramp`'s tenant wheel). Token ids are
+    drawn from a seeded RNG so the workload is reproducible and the
+    bit-identity harnesses can replay it."""
+    wheel = []
+    for length, weight in sorted(dist.items()):
+        if int(length) < 1:
+            raise ValueError(f"prompt length must be >= 1, got {length}")
+        wheel.extend([int(length)] * max(1, int(weight)))
+    if not wheel:
+        raise ValueError("mixed_prompts needs a non-empty distribution")
+    if n is None:
+        n = len(wheel)
+    rs = np.random.RandomState(seed)
+    return [rs.randint(int(vocab_size),
+                       size=wheel[i % len(wheel)]).astype(np.int32)
+            for i in range(int(n))]
+
+
 def token_closed_loop(batcher, prompts, clients, per_client,
                       max_new_tokens=8, timeout=300, deadline_ms=None,
                       retries=0, backoff_ms=25, jitter=0.5):
@@ -329,8 +353,16 @@ def token_closed_loop(batcher, prompts, clients, per_client,
     the decode autotuning objective is built from. The same
     ``retries``/``backoff_ms``/``jitter`` admission-retry policy as
     :func:`closed_loop` applies to the submit call (``Overloaded``
-    only — a stream that already produced tokens is never replayed)."""
-    ttfts, itls = [], []
+    only — a stream that already produced tokens is never replayed).
+
+    ``prompts`` may mix lengths freely (see :func:`mixed_prompts`);
+    the result's ``by_length`` section breaks TTFT/ITL percentiles
+    down PER PROMPT-LENGTH BUCKET — the aggregate p99 of a mixed
+    workload hides exactly the effect disaggregated prefill exists to
+    fix (a long prompt's prefill landing between a short stream's
+    tokens), so the per-bucket view is what the disagg-vs-unified
+    comparison gates on."""
+    ttfts, itls = [], []            # (prompt_len, seconds)
     tokens = [0]
     failed = [0]
     lock = threading.Lock()
@@ -341,6 +373,7 @@ def token_closed_loop(batcher, prompts, clients, per_client,
         my_ttft, my_itl, my_toks, my_failed = [], [], 0, 0
         for i in range(per_client):
             prompt = prompts[(cid + i * clients) % len(prompts)]
+            plen = len(prompt)
             t_r = time.perf_counter()
             deadline = t_r + deadline_ms / 1e3 \
                 if deadline_ms is not None else None
@@ -356,9 +389,9 @@ def token_closed_loop(batcher, prompts, clients, per_client,
             for _ in stream:
                 now = time.perf_counter()
                 if t_last is None:
-                    my_ttft.append(now - t_r)
+                    my_ttft.append((plen, now - t_r))
                 else:
-                    my_itl.append(now - t_last)
+                    my_itl.append((plen, now - t_last))
                 t_last = now
                 my_toks += 1
         with lock:
@@ -381,16 +414,30 @@ def token_closed_loop(batcher, prompts, clients, per_client,
     def _pct(xs, q):
         return float(np.percentile(xs, q)) * 1e3 if xs else None
 
+    by_length = {}
+    for plen in sorted({p for p, _ in ttfts} | {p for p, _ in itls}):
+        bt = [s for p, s in ttfts if p == plen]
+        bi = [s for p, s in itls if p == plen]
+        by_length[plen] = {
+            "streams": len(bt),
+            "ttft_p50_ms": _pct(bt, 50),
+            "ttft_p99_ms": _pct(bt, 99),
+            "inter_token_p50_ms": _pct(bi, 50),
+            "inter_token_p99_ms": _pct(bi, 99),
+        }
+    all_ttft = [s for _, s in ttfts]
+    all_itl = [s for _, s in itls]
     return {
         "tok_s": tokens[0] / dt,
         "gen_s": clients * per_client / dt,
-        "ttft_p50_ms": _pct(ttfts, 50),
-        "ttft_p99_ms": _pct(ttfts, 99),
-        "inter_token_p50_ms": _pct(itls, 50),
-        "inter_token_p99_ms": _pct(itls, 99),
+        "ttft_p50_ms": _pct(all_ttft, 50),
+        "ttft_p99_ms": _pct(all_ttft, 99),
+        "inter_token_p50_ms": _pct(all_itl, 50),
+        "inter_token_p99_ms": _pct(all_itl, 99),
         "tokens": tokens[0],
         "wall_s": dt,
         "gave_up": failed[0],
+        "by_length": by_length,
     }
 
 

@@ -70,7 +70,16 @@ class TenantSpec:
         Max in-flight admitted requests before this tenant's submits
         shed (default ``weight x MXTPU_FLEET_TENANT_QUOTA``).
     replicas : int
-        Replica count the group starts with.
+        UNIFIED replica count the group starts with (each prefills AND
+        decodes). May be 0 for a disaggregated group.
+    prefill_replicas / decode_replicas : int, optional
+        Disaggregated prefill/decode formation (round 21, defaults
+        ``MXTPU_FLEET_ROLE_PREFILL`` / ``MXTPU_FLEET_ROLE_DECODE``):
+        with BOTH > 0 the group runs role-split — prefill replicas
+        fill KV lanes and hand each one to a decode replica
+        (``DecodeBatcher.set_handoff``/``adopt``), so a long prompt's
+        prefill never lands between another stream's tokens. The
+        factory is called with ``role=`` when it accepts the kwarg.
     min_replicas / max_replicas : int, optional
         Autoscaler bounds for this group (default the
         ``MXTPU_FLEET_{MIN,MAX}_REPLICAS`` env vars).
@@ -82,12 +91,27 @@ class TenantSpec:
 
     def __init__(self, name, factory=None, slo_class="latency",
                  priority=None, weight=None, quota=None, replicas=1,
-                 min_replicas=None, max_replicas=None, slo_p99_ms=None):
+                 min_replicas=None, max_replicas=None, slo_p99_ms=None,
+                 prefill_replicas=None, decode_replicas=None):
         if slo_class not in SLO_CLASSES:
             raise MXNetError(
                 f"tenant '{name}': slo_class must be one of "
                 f"{SLO_CLASSES}, got {slo_class!r}")
-        if replicas < 1:
+        self.prefill_replicas = int(
+            prefill_replicas if prefill_replicas is not None
+            else config.get("MXTPU_FLEET_ROLE_PREFILL", 0))
+        self.decode_replicas = int(
+            decode_replicas if decode_replicas is not None
+            else config.get("MXTPU_FLEET_ROLE_DECODE", 0))
+        if (self.prefill_replicas > 0) != (self.decode_replicas > 0):
+            raise MXNetError(
+                f"tenant '{name}': disaggregation needs BOTH "
+                f"prefill_replicas and decode_replicas > 0 (got "
+                f"{self.prefill_replicas}/{self.decode_replicas}) — a "
+                "prefill replica without a decode sink would decode "
+                "locally, which is just a unified replica")
+        if int(replicas) + self.prefill_replicas + \
+                self.decode_replicas < 1:
             raise MXNetError(f"tenant '{name}' needs >= 1 replica")
         cls = _CLASS_DEFAULTS[slo_class]
         self.name = str(name)
@@ -108,10 +132,24 @@ class TenantSpec:
             else config.get("MXTPU_FLEET_MAX_REPLICAS", 4))
         self.slo_p99_ms = None if slo_p99_ms is None else float(slo_p99_ms)
 
+    @property
+    def disaggregated(self):
+        """True when this group runs the split prefill/decode
+        formation (both role counts > 0)."""
+        return self.prefill_replicas > 0 and self.decode_replicas > 0
+
+    @property
+    def total_replicas(self):
+        """Initial formation size across every role."""
+        return self.replicas + self.prefill_replicas + \
+            self.decode_replicas
+
     def __repr__(self):
         return (f"TenantSpec({self.name!r}, slo_class={self.slo_class!r},"
                 f" priority={self.priority}, weight={self.weight},"
-                f" quota={self.quota}, replicas={self.replicas})")
+                f" quota={self.quota}, replicas={self.replicas},"
+                f" prefill={self.prefill_replicas},"
+                f" decode={self.decode_replicas})")
 
 
 class _TenantLedger:

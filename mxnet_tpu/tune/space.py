@@ -30,7 +30,7 @@ from typing import Dict, List, Optional, Sequence
 
 __all__ = ["Knob", "SearchSpace", "pass_knobs", "tile_knobs",
            "data_knobs", "serving_knobs", "decode_knobs", "batch_knob",
-           "quant_knobs"]
+           "quant_knobs", "spec_knobs"]
 
 
 class Knob:
@@ -230,6 +230,29 @@ def quant_knobs(granularities: Sequence[str] = ("per_channel",
              kind="env", doc="int8 PTQ weight-scale granularity"),
         Knob("MXTPU_DECODE_KV_DTYPE", tuple(kv_dtypes), kind="env",
              doc="decode KV-cache storage dtype"),
+    ]
+
+
+def spec_knobs(ks: Sequence[int] = (4, 2, 6),
+               shrinks: Sequence[int] = (2, 4),
+               draft_layers: Sequence[int] = (1,)) -> List[Knob]:
+    """Speculative-decode posture knobs (round 21): speculation depth
+    ``k`` (draft tokens offered per verify round — deeper amortizes the
+    verify launch over more candidate tokens but wastes draft work past
+    the first rejection) × draft size (embed/head shrink factor and
+    layer count vs. the target — a smaller draft is cheaper per
+    proposal but accepts less). Neither tail is knowable analytically:
+    the product ``bytes-moved-per-ACCEPTED-token`` is what the trial
+    measures, and the defaults (first values — ``MXTPU_SPEC_K``'s
+    registered default and the ``make_draft_spec`` defaults) are the
+    untuned posture every win is measured against."""
+    return [
+        Knob("spec_k", tuple(int(k) for k in ks), kind="param",
+             doc="speculation depth (draft tokens per verify round)"),
+        Knob("draft_shrink", tuple(int(s) for s in shrinks),
+             kind="param", doc="draft embed/head shrink vs target"),
+        Knob("draft_layers", tuple(int(n) for n in draft_layers),
+             kind="param", doc="draft transformer layer count"),
     ]
 
 

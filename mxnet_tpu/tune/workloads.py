@@ -36,12 +36,13 @@ from __future__ import annotations
 from typing import Dict, Optional, Sequence
 
 from .space import SearchSpace, Knob, pass_knobs, batch_knob, \
-    serving_knobs, data_knobs, decode_knobs, quant_knobs
+    serving_knobs, data_knobs, decode_knobs, quant_knobs, spec_knobs
 
 __all__ = ["Workload", "TrainStepWorkload", "ServingWorkload",
            "DecodeServingWorkload", "DataPipelineWorkload",
-           "QuantWorkload", "conv_proxy", "sparse_proxy", "decode_proxy",
-           "quant_proxy", "builtin_workload", "measure_serving",
+           "QuantWorkload", "SpecDecodeWorkload", "conv_proxy",
+           "sparse_proxy", "decode_proxy", "quant_proxy",
+           "spec_decode_proxy", "builtin_workload", "measure_serving",
            "measure_decode_serving", "BUILTIN_WORKLOADS"]
 
 
@@ -380,6 +381,123 @@ class DecodeServingWorkload(Workload):
 
 
 # ---------------------------------------------------------------------------
+# speculative decode: bytes-per-ACCEPTED-token over k × draft size
+# ---------------------------------------------------------------------------
+class SpecDecodeWorkload(Workload):
+    """Round-21 speculative-posture search: speculation depth ``k`` ×
+    draft shrink factor × draft layer count. The expensive half per
+    draft-size point is DISTILLATION (``spec.distill_draft`` — the
+    draft is trained to imitate the target's greedy rollouts), cached
+    per (shrink, layers) so every ``k`` trial at that size reuses it;
+    the measurement streams a fixed prompt set through a speculative
+    ``DecodeBatcher`` and reads the predictor's own accounting.
+
+    The objective is ``spec_bytes_per_accepted_token`` — XLA
+    cost-analysis bytes of one verify launch plus ``k`` draft steps,
+    divided by the tokens the verify rounds actually emitted. It is the
+    r12 gate currency normalized by the quantity speculation exists to
+    maximize: a deep ``k`` with a bad draft measures WORSE than plain
+    decode (wasted draft bytes), and so does a draft so large its own
+    steps eat the amortization — only the measured trial sees where
+    acceptance and draft cost balance."""
+
+    objective = "spec_bytes_per_accepted_token"
+
+    def __init__(self, name, spec, params, prompts,
+                 space: Optional[SearchSpace] = None,
+                 ks: Sequence[int] = (4, 2, 6),
+                 shrinks: Sequence[int] = (2, 4),
+                 draft_layers: Sequence[int] = (1,),
+                 slots: int = 2, seq_buckets: Sequence[int] = (16,),
+                 max_new_tokens: int = 12, distill_rollout: int = 40,
+                 distill_epochs: int = 6):
+        space = space or SearchSpace(
+            spec_knobs(ks, shrinks, draft_layers), name=f"{name}-spec")
+        super().__init__(space)
+        self.name = name
+        self.spec = spec
+        self.params = dict(params)
+        self.prompts = list(prompts)
+        self.slots = int(slots)
+        self.seq_buckets = tuple(int(b) for b in seq_buckets)
+        self.max_new_tokens = int(max_new_tokens)
+        self.distill_rollout = int(distill_rollout)
+        self.distill_epochs = int(distill_epochs)
+        self._target = None          # distillation rollout source
+        self._drafts = {}            # (shrink, layers) -> (spec, params)
+
+    def key_material(self):
+        m = super().key_material()
+        m["extra"] = dict(m["extra"], **self.spec.key_material())
+        m["input_sigs"] = [
+            ("prompt_lens", tuple(int(p.shape[0]) for p in self.prompts)),
+            ("slots", self.slots), ("seq_buckets", self.seq_buckets),
+            ("max_new_tokens", self.max_new_tokens),
+            ("distill", (self.distill_rollout, self.distill_epochs))]
+        return m
+
+    def _draft(self, shrink, layers):
+        key = (int(shrink), int(layers))
+        if key not in self._drafts:
+            from ..serving.decode import DecodePredictor
+            from ..serving.decode.spec import make_draft_spec, \
+                distill_draft
+            if self._target is None:
+                self._target = DecodePredictor(
+                    self.spec, self.params, slots=1,
+                    seq_buckets=self.seq_buckets,
+                    name=f"{self.name}-distill-src")
+            dspec = make_draft_spec(self.spec, num_layers=int(layers),
+                                    shrink=int(shrink),
+                                    name=f"{self.name}-d{shrink}x{layers}")
+            dparams = distill_draft(self._target, dspec,
+                                    rollout=self.distill_rollout,
+                                    num_epoch=self.distill_epochs,
+                                    seed=0)
+            self._drafts[key] = (dspec, dparams)
+        return self._drafts[key]
+
+    def measure(self, cfg, budget):
+        from ..base import MXNetError
+        from ..serving.decode import DecodeBatcher
+        from ..serving.decode.spec import SpecDecodePredictor
+        dspec, dparams = self._draft(cfg["draft_shrink"],
+                                     cfg["draft_layers"])
+        pred = SpecDecodePredictor(
+            self.spec, self.params, dspec, dparams,
+            k=int(cfg["spec_k"]), slots=self.slots,
+            seq_buckets=self.seq_buckets,
+            name=f"{self.name}-k{cfg['spec_k']}")
+        pred.warmup()
+        with DecodeBatcher(pred, max_wait_us=0, max_queue=100_000,
+                           name=f"tune-spec{cfg['spec_k']}") as bat:
+            for _ in range(max(1, budget)):
+                streams = [bat.submit(
+                    p, max_new_tokens=self.max_new_tokens)
+                    for p in self.prompts]
+                for s in streams:
+                    for _tok in s:
+                        pass
+        rep = pred.report()["spec"]
+        bpt = pred.spec_bytes_per_accepted_token()
+        if bpt is None:
+            raise MXNetError(
+                f"{self.name}: no verify rounds ran (or the backend "
+                "exposes no cost analysis) — the bytes-per-accepted-"
+                "token objective cannot be measured")
+        plain = pred.decode_bytes_per_token()
+        return {"objective": float(bpt),
+                "plain_bytes_per_token": plain,
+                "bytes_ratio_vs_plain":
+                    float(bpt) / plain if plain else None,
+                "accepted_per_step": rep["accepted_per_step"],
+                "acceptance_rate": rep["acceptance_rate"],
+                "rounds": rep["rounds"],
+                "degrade_events": rep["degrade_events"],
+                "retraces": pred.retraces}
+
+
+# ---------------------------------------------------------------------------
 # quantization posture: total-bytes objective over granularity × KV dtype
 # ---------------------------------------------------------------------------
 class QuantWorkload(Workload):
@@ -654,8 +772,35 @@ def quant_proxy(batch: int = 4, slots: int = 2,
     return wl
 
 
+def spec_decode_proxy(ks=(4, 2), shrinks=(2,), draft_layers=(1,),
+                      slots: int = 2, seq_buckets=(16,),
+                      max_new_tokens: int = 10) -> SpecDecodeWorkload:
+    """The speculative-decode built-in: a pocket transformer target
+    (deterministic seed-0 weights) with per-trial distilled drafts,
+    searched over depth × draft size against the
+    bytes-per-accepted-token objective. Distillation epochs are kept
+    small — the proxy exists to exercise the search loop at
+    interactive CPU cost, not to reach bench-grade acceptance."""
+    import numpy as np
+    from ..serving.decode import TransformerLMSpec, init_params
+    spec = TransformerLMSpec(vocab_size=64, num_embed=32, num_heads=2,
+                             num_layers=2, max_seq=48, name="speclm")
+    params = init_params(spec, seed=0)
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(1, spec.vocab_size, size=n).astype(np.int32)
+               for n in (5, 9, 3, 12)]
+    wl = SpecDecodeWorkload(
+        "spec_decode_lm", spec, params, prompts, ks=ks, shrinks=shrinks,
+        draft_layers=draft_layers, slots=slots, seq_buckets=seq_buckets,
+        max_new_tokens=max_new_tokens, distill_rollout=24,
+        distill_epochs=4)
+    wl.builtin = "spec_decode"
+    return wl
+
+
 BUILTIN_WORKLOADS = {"conv": conv_proxy, "sparse": sparse_proxy,
-                     "decode": decode_proxy, "quant": quant_proxy}
+                     "decode": decode_proxy, "quant": quant_proxy,
+                     "spec_decode": spec_decode_proxy}
 
 
 def builtin_workload(name: str, **kwargs) -> Workload:

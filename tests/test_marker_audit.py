@@ -182,6 +182,27 @@ def test_telemetry_suite_stays_tier1_with_chaos_marked():
         "pytest.mark.chaos like the other fault-injection suites")
 
 
+def test_spec_decode_suite_stays_tier1_with_chaos_marked():
+    """The speculative/disagg suite is tier-1's only proof that
+    speculative decoding is BIT-IDENTICAL to solo greedy decode and
+    that the prefill->decode lane handoff survives a lost transfer
+    with zero dropped streams. It must (a) exist, (b) mark its
+    ``spec_verify`` storm and ``kv_handoff`` loss drills ``chaos``
+    like the other fault-injection suites, and (c) never grow a
+    ``slow`` mark that would drop the round-21 acceptance pins from
+    the ``-m 'not slow'`` gate."""
+    path = os.path.join(_TESTS, "test_spec_decode.py")
+    assert os.path.exists(path), "tests/test_spec_decode.py missing"
+    uses = _mark_uses()
+    assert "test_spec_decode.py" in uses.get("chaos", set()), (
+        "test_spec_decode.py must carry pytest.mark.chaos on its "
+        "spec_verify storm / kv_handoff loss drills — they ride the "
+        "deterministic faultinject sites like the other fault suites")
+    assert "test_spec_decode.py" not in uses.get("slow", set()), (
+        "test_spec_decode.py must stay tier-1: bit-identity and the "
+        "zero-dropped-handoff pins are round-21 acceptance criteria")
+
+
 def test_serving_fast_paths_stay_in_tier1():
     """Timing-SLO serving cases (throughput-efficiency pins) are
     ``slow``; everything functional — retrace pinning, shedding,

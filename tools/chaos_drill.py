@@ -54,6 +54,24 @@ recovery assertion that makes it a drill rather than a demo:
     bit-identically to a predictor freshly built on the new
     checkpoint.
 
+``spec_storm``
+    Speculative-decode drill (round 21): streaming clients over a
+    ``SpecDecodePredictor`` while EVERY speculative round's proposals
+    are replaced with deliberately wrong tokens (``spec_verify``
+    divergence storm — acceptance pinned to zero). PASS requires every
+    stream BIT-IDENTICAL to the solo greedy oracle (accept-prefix is
+    unconditionally correct) and the windowed degrade policy dropping
+    the engine to plain decode — never a corrupted stream, never a
+    storm ridden at full speculation cost.
+
+``disagg_handoff``
+    Disaggregated prefill/decode drill (round 21): a prefill+decode
+    formation behind the FleetRouter with EVERY KV-lane handoff killed
+    mid-transfer (``kv_handoff`` — the exported lane is lost after
+    prefill). PASS requires the decode side to RE-PREFILL every lost
+    lane locally and every stream to complete bit-identical to the
+    solo oracle with zero dropped tokens.
+
 Usage:
     python tools/chaos_drill.py [--scenario S] [--workdir D]
         [--epochs N] [--fault SPEC] [--corrupt]   # ckpt knobs
@@ -402,6 +420,136 @@ def drill_hot_swap(args, workdir):
     return 0 if ok else 1
 
 
+def _pocket_lm(seed=3):
+    """A pocket transformer LM + deterministic mixed-length prompts +
+    the solo greedy oracle the streaming drills pin bit-identity
+    against."""
+    import numpy as np
+
+    from mxnet_tpu.serving.decode import (DecodePredictor,
+                                          TransformerLMSpec, init_params)
+    spec = TransformerLMSpec(vocab_size=61, num_embed=32, num_heads=2,
+                             num_layers=2, max_seq=48, name="chaoslm")
+    params = init_params(spec, seed=seed)
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(61, size=n).astype(np.int32)
+               for n in (3, 9, 5, 14, 7, 4, 11, 6)]
+    solo = DecodePredictor(spec, params, slots=1, seq_buckets=(16,),
+                           name="chaos-oracle")
+    oracle = [list(solo.generate(p, max_new_tokens=10)) for p in prompts]
+    return spec, params, prompts, oracle
+
+
+def drill_spec_storm(args, workdir):
+    """Every speculative round storms (draft/target divergence):
+    streams must stay bit-exact and the engine must degrade to plain
+    decode instead of riding a 0%-acceptance draft."""
+    from mxnet_tpu import faultinject
+    from mxnet_tpu.serving.decode import DecodeBatcher, init_params
+    from mxnet_tpu.serving.decode.spec import (SpecDecodePredictor,
+                                               make_draft_spec)
+
+    spec, params, prompts, oracle = _pocket_lm()
+    dspec = make_draft_spec(spec, num_layers=1, shrink=2)
+    pred = SpecDecodePredictor(
+        spec, params, dspec, init_params(dspec, seed=11),
+        slots=3, seq_buckets=(16,), name="stormspec",
+        window=8, probe_steps=1000)
+    pred.warmup()
+    print("[1/3] speculative batcher up (k="
+          f"{pred.spec_k}, window=8); arming the divergence storm")
+    with DecodeBatcher(pred, max_wait_us=500, name="storm") as bat:
+        with faultinject.inject(spec_verify={}):
+            streams = [bat.submit(p, max_new_tokens=10)
+                       for p in prompts]
+            got = [[t for t in s] for s in streams]
+        rep = pred.report()["spec"]
+    fired = faultinject.fired("spec_verify")
+    print(f"[2/3] fired={fired} rounds={rep['rounds']} "
+          f"acceptance_rate={rep['acceptance_rate']} "
+          f"degrade_events={rep['degrade_events']} "
+          f"degraded={rep['degraded']}")
+    bit_ok = got == oracle
+    print("[3/3] bit-identity vs solo greedy oracle: "
+          + ("OK" if bit_ok else "MISMATCH"))
+    ok = True
+    if not fired:
+        print("FAIL: the spec_verify storm never fired")
+        ok = False
+    if not bit_ok:
+        print("FAIL: a stream diverged from the solo oracle — the "
+              "storm corrupted output")
+        ok = False
+    if rep["degrade_events"] < 1:
+        print("FAIL: acceptance collapsed but the engine never "
+              "degraded to plain decode")
+        ok = False
+    if rep["acceptance_rate"] not in (None, 0.0):
+        print(f"FAIL: storm rounds recorded nonzero acceptance "
+              f"({rep['acceptance_rate']})")
+        ok = False
+    if ok:
+        print("PASS: full divergence storm: streams bit-exact, "
+              f"engine degraded to plain decode after "
+              f"{rep['degrade_events']} trigger(s)")
+    return 0 if ok else 1
+
+
+def drill_disagg_handoff(args, workdir):
+    """Kill EVERY prefill->decode KV-lane transfer: the decode side
+    must re-prefill each lane and finish every stream with zero
+    dropped tokens."""
+    from mxnet_tpu import faultinject, serving
+    from mxnet_tpu.serving import TenantSpec
+    from mxnet_tpu.serving.decode import DecodeBatcher, DecodePredictor
+
+    spec, params, prompts, oracle = _pocket_lm()
+
+    def factory(role="unified"):
+        eng = DecodePredictor(spec, params, slots=4, seq_buckets=(16,),
+                              name="hochaos")
+        return DecodeBatcher(eng, max_wait_us=500, name="hochaos",
+                             role=role)
+
+    router = serving.FleetRouter(tenants=[
+        TenantSpec("lm", factory=factory, replicas=0,
+                   prefill_replicas=1, decode_replicas=1, quota=64)],
+        name="handoff-chaos").start()
+    print("[1/3] 1 prefill + 1 decode replica up; killing every "
+          "lane transfer mid-handoff")
+    with faultinject.inject(kv_handoff={}):
+        futs = [router.submit(p, max_new_tokens=10, tenant="lm")
+                for p in prompts]
+        got = [f.result(timeout=120) for f in futs]
+    fired = faultinject.fired("kv_handoff")
+    rep = router.report()
+    router.stop()
+    adopted = sum(r.get("adopted", 0) for r in rep["replicas"])
+    handoffs = sum(r.get("handoffs", 0) for r in rep["replicas"])
+    print(f"[2/3] fired={fired} handoffs={handoffs} adopted={adopted}")
+    bit_ok = got == oracle
+    print("[3/3] bit-identity vs solo greedy oracle: "
+          + ("OK" if bit_ok else "MISMATCH"))
+    ok = True
+    if fired < len(prompts):
+        print(f"FAIL: only {fired}/{len(prompts)} handoffs hit the "
+              "fault — the drill never covered every transfer")
+        ok = False
+    if not bit_ok:
+        print("FAIL: a stream lost or corrupted tokens across the "
+              "killed handoff")
+        ok = False
+    if adopted < len(prompts):
+        print(f"FAIL: only {adopted}/{len(prompts)} lanes landed on "
+              "the decode side")
+        ok = False
+    if ok:
+        print(f"PASS: {fired} killed handoffs, every lane "
+              "re-prefilled on the decode replica, zero dropped "
+              "tokens")
+    return 0 if ok else 1
+
+
 def _elastic_env():
     env = dict(os.environ)
     env.pop("MXTPU_FAULT_INJECT", None)
@@ -537,7 +685,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scenario", default="ckpt",
                     choices=("ckpt", "replica_drop", "heartbeat_miss",
-                             "dist_drop", "ramp_scale", "hot_swap"))
+                             "dist_drop", "ramp_scale", "hot_swap",
+                             "spec_storm", "disagg_handoff"))
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--epochs", type=int, default=4)
     ap.add_argument("--fault",
@@ -558,7 +707,9 @@ def main():
              "heartbeat_miss": drill_heartbeat_miss,
              "dist_drop": drill_dist_drop,
              "ramp_scale": drill_ramp_scale,
-             "hot_swap": drill_hot_swap}[args.scenario]
+             "hot_swap": drill_hot_swap,
+             "spec_storm": drill_spec_storm,
+             "disagg_handoff": drill_disagg_handoff}[args.scenario]
     return drill(args, workdir)
 
 

@@ -66,6 +66,16 @@ section (a pre-r19 baseline reports the new readings ungated, the
 buying fewer bytes than it used to — a quantization regression even
 when absolute bytes shrank for other reasons.
 
+Round 21 (speculative decode): ``diff`` also reads a BENCH file's
+``speculative_decode`` section — bytes-moved-per-ACCEPTED-token as a
+fraction of the plain decode step's bytes-per-token, plus the
+accepted-tokens-per-verify-round reading it stands on — and under
+``--gate-bytes`` gates the ratio when BOTH files carry the section (a
+pre-r21 baseline reports the new readings ungated, the
+``quantized_serving`` precedent). A growing ratio means speculation is
+amortizing less per token actually kept — a draft-quality or
+verify-cost regression even when raw tok/s moved the other way.
+
 Round 20 (autoscaling + multi-tenancy): ``diff --gate-slo`` reads a
 BENCH file's ``fleet_autoscale`` section — per-tenant
 ``slo_violations`` counts from the chaos-drilled ramp (requests that
@@ -270,6 +280,21 @@ def _load_quantized(tree):
     }
 
 
+def _load_speculative(tree):
+    """The BENCH ``speculative_decode`` section's gateable readings, or
+    None when the file predates round 21 (or the section errored)."""
+    s = tree.get("speculative_decode")
+    if not isinstance(s, dict) or \
+            "bytes_per_accepted_token_ratio" not in s:
+        return None
+    return {
+        "bytes_per_accepted_token_ratio":
+            s.get("bytes_per_accepted_token_ratio"),
+        "accepted_per_step": s.get("accepted_per_step"),
+        "acceptance_rate": s.get("acceptance_rate"),
+    }
+
+
 def _load_bytes(tree, path):
     """bytes-accessed-per-step from a snapshot (metrics gauge), a
     BENCH JSON (bench.py's ``xla_bytes_accessed_per_step``), or — for
@@ -296,10 +321,17 @@ def _load_bytes(tree, path):
     q = tree.get("quantized_serving")
     if isinstance(q, dict) and q.get("decode_step_bytes_int8"):
         return float(q["decode_step_bytes_int8"])
+    # speculative-only BENCH file (bench.py speculative_decode
+    # standalone mode): the plain decode step's per-token bytes — the
+    # baseline the speculative ratio in that run is measured against
+    s = tree.get("speculative_decode")
+    if isinstance(s, dict) and s.get("plain_decode_bytes_per_token"):
+        return float(s["plain_decode_bytes_per_token"])
     sys.exit(f"{path}: no {BYTES_METRIC} metric (and no "
-             "xla_bytes_accessed_per_step, multichip_fused, or "
-             "quantized_serving field) — not a telemetry snapshot/"
-             "BENCH file, or the run recorded no step costs")
+             "xla_bytes_accessed_per_step, multichip_fused, "
+             "quantized_serving, or speculative_decode field) — not a "
+             "telemetry snapshot/BENCH file, or the run recorded no "
+             "step costs")
 
 
 def _bytes_source(tree):
@@ -484,6 +516,27 @@ def cmd_diff(args):
                     "no quantized_serving section in "
                     f"{args.old} (pre-r19) — reading recorded, not gated")
             result["gate_bytes_quantized"] = entry
+        # round-21 sibling: the speculative_decode section's
+        # bytes-per-ACCEPTED-token RATIO (speculative path / plain
+        # decode step). Ratio, not absolute — the gate judges what
+        # speculation amortizes per kept token independently of
+        # model-size drift. Gated only when BOTH files carry the
+        # section; a pre-r21 baseline reports the new readings ungated
+        old_s, new_s = _load_speculative(old_t), _load_speculative(new_t)
+        if new_s is not None:
+            entry = dict(new_s)
+            ors = (old_s or {}).get("bytes_per_accepted_token_ratio")
+            nrs = new_s.get("bytes_per_accepted_token_ratio")
+            if ors and nrs:
+                entry["old_bytes_per_accepted_token_ratio"] = ors
+                entry["regressed"] = bool(nrs > ors * (1.0 + tol))
+                gate_failed = gate_failed or entry["regressed"]
+            else:
+                entry["regressed"] = False
+                entry["baseline"] = (
+                    "no speculative_decode section in "
+                    f"{args.old} (pre-r21) — reading recorded, not gated")
+            result["gate_bytes_speculative"] = entry
     mem_failed = False
     if args.gate_peak_mem:
         old_m = _load_peak_mem(old_t, args.old)
@@ -576,6 +629,20 @@ def cmd_diff(args):
                           f"{q.get('decode_step_bytes_ratio')}, KV cache "
                           f"{q.get('kv_cache_ratio')} "
                           "(new baseline, ungated)")
+            sp = result.get("gate_bytes_speculative")
+            if sp:
+                if "old_bytes_per_accepted_token_ratio" in sp:
+                    print(f"speculative bytes/accepted-token ratio: "
+                          f"{sp['old_bytes_per_accepted_token_ratio']:.4f}"
+                          f" -> "
+                          f"{sp['bytes_per_accepted_token_ratio']:.4f}; "
+                          f"accepted/step "
+                          f"{sp.get('accepted_per_step')}")
+                else:
+                    print(f"speculative bytes/accepted-token ratio: "
+                          f"{sp['bytes_per_accepted_token_ratio']:.4f}, "
+                          f"accepted/step {sp.get('accepted_per_step')} "
+                          "(new baseline, ungated)")
         if args.gate_peak_mem:
             g = result["gate_peak_mem"]
             print(f"peak HBM: {g['old_peak_bytes']:.6g} -> "
@@ -624,6 +691,17 @@ def cmd_diff(args):
                   "stopped fusing, or a site stopped quantizing). Fix "
                   "the pass or re-baseline deliberately.",
                   file=sys.stderr)
+        sp = result.get("gate_bytes_speculative") or {}
+        if sp.get("regressed"):
+            print("BYTES REGRESSION (speculative): bytes moved per "
+                  "ACCEPTED token grew as a fraction of the plain "
+                  "decode step's bytes-per-token ("
+                  f"{sp.get('old_bytes_per_accepted_token_ratio')} -> "
+                  f"{sp.get('bytes_per_accepted_token_ratio')}, "
+                  f"accepted/step {sp.get('accepted_per_step')}) — the "
+                  "draft accepts less or the verify program costs more "
+                  "than the baseline. Fix the draft/depth or "
+                  "re-baseline deliberately.", file=sys.stderr)
     if mem_failed:
         print(f"PEAK-MEM REGRESSION: {PEAK_MEM_METRIC} grew "
               f"{result['gate_peak_mem']['delta_pct']:+.3f}% (> "
